@@ -1,0 +1,20 @@
+"""dba_mod_tpu — a TPU-native (JAX/XLA/pjit) federated-learning backdoor-research
+framework with the capabilities of the DBA reference (ICLR 2020 code,
+`ehsan886/DBA_mod`).
+
+The reference is a single-process PyTorch simulator; this framework re-designs the
+same capability surface TPU-first:
+
+- clients are a *mesh axis*, not a Python loop: local training is one jitted,
+  vmapped/pjit-sharded XLA computation over stacked client state;
+- triggers, aggregation (FedAvg / RFA geometric median / FoolsGold) and the
+  evaluation battery are pure on-device jnp programs;
+- the round loop on the host only schedules, selects and records.
+
+Public entry points:
+    dba_mod_tpu.config.Params.from_yaml      — reference-schema YAML configs
+    dba_mod_tpu.fl.experiment.Experiment     — end-to-end FL experiment driver
+    dba_mod_tpu.main                         — CLI (python -m dba_mod_tpu.main)
+"""
+
+__version__ = "0.1.0"

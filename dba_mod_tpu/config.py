@@ -1,0 +1,230 @@
+"""Experiment configuration.
+
+Accepts the reference's flat-YAML schema verbatim (same key names, including the
+stringly per-adversary keys ``{i}_poison_epochs`` / ``{i}_poison_pattern`` /
+``{i}_poison_trigger_names`` / ``{i}_poison_trigger_values`` — see reference
+`utils/cifar_params.yaml`, `image_train.py:43`, `loan_train.py:51-57`), but exposes
+them through typed accessors so the rest of the framework never string-concatenates
+config keys.
+
+Unlike the reference (which mutates the params dict at runtime, `helper.py:44-48`),
+``Params`` is read-mostly: runtime-derived fields live in explicit attributes.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+# Dataset type tags (reference config.py:10-13).
+TYPE_CIFAR = "cifar"
+TYPE_MNIST = "mnist"
+TYPE_TINYIMAGENET = "tiny-imagenet-200"
+TYPE_LOAN = "loan"
+
+IMAGE_TYPES = (TYPE_CIFAR, TYPE_MNIST, TYPE_TINYIMAGENET)
+
+# Aggregation method names (reference config.py:4-6).
+AGGR_MEAN = "mean"
+AGGR_GEO_MED = "geom_median"
+AGGR_FOOLSGOLD = "foolsgold"
+
+_REQUIRED_KEYS = ("type", "lr", "batch_size", "epochs", "no_models",
+                  "number_of_total_participants", "eta", "aggregation_methods")
+
+_DEFAULTS: Dict[str, Any] = {
+    "test_batch_size": 64,
+    "momentum": 0.9,
+    "decay": 0.0005,
+    "internal_epochs": 1,
+    "internal_poison_epochs": 1,
+    "poisoning_per_batch": 1,
+    "aggr_epoch_interval": 1,
+    "geom_median_maxiter": 10,
+    "fg_use_memory": True,
+    "participants_namelist": [],
+    "is_random_namelist": True,
+    "is_random_adversary": False,
+    "is_poison": False,
+    "baseline": False,
+    "scale_weights_poison": 1.0,
+    "sampling_dirichlet": True,
+    "dirichlet_alpha": 0.5,
+    "poison_label_swap": 0,
+    "adversary_list": [],
+    "centralized_test_trigger": True,
+    "trigger_num": 0,
+    "poison_epochs": [],
+    "poison_lr": 0.05,
+    "poison_step_lr": True,
+    "alpha_loss": 1.0,
+    "diff_privacy": False,
+    "sigma": 0.01,
+    "save_model": False,
+    "save_on_epochs": [],
+    "resumed_model": False,
+    "resumed_model_name": "",
+    "environment_name": "dba_tpu",
+    "log_interval": 2,
+    "results_json": True,
+    "random_seed": 1,
+    # framework-specific knobs (not in the reference schema)
+    "data_dir": "./data",
+    "synthetic_data": False,       # force the synthetic dataset backend
+    "synthetic_train_size": 0,     # 0 = backend default
+    "num_devices": 0,              # 0 = use all visible devices on the clients mesh
+    "run_dir": "./runs",
+}
+
+
+@dataclasses.dataclass
+class Params:
+    """Typed view over a reference-schema config dict."""
+
+    raw: Dict[str, Any]
+    current_time: str = dataclasses.field(
+        default_factory=lambda: time.strftime("%b.%d_%H.%M.%S"))
+
+    # ------------------------------------------------------------------ loading
+    @classmethod
+    def from_yaml(cls, path: str | Path) -> "Params":
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "Params":
+        merged = copy.deepcopy(_DEFAULTS)
+        merged.update(raw or {})
+        missing = [k for k in _REQUIRED_KEYS if k not in merged]
+        if missing:
+            raise ValueError(f"config missing required keys: {missing}")
+        if merged["aggregation_methods"] not in (AGGR_MEAN, AGGR_GEO_MED, AGGR_FOOLSGOLD):
+            raise ValueError(
+                f"unknown aggregation_methods: {merged['aggregation_methods']!r}")
+        if merged["type"] not in IMAGE_TYPES + (TYPE_LOAN,):
+            raise ValueError(f"unknown workload type: {merged['type']!r}")
+        return cls(raw=merged)
+
+    # ------------------------------------------------------------- dict access
+    def __getitem__(self, key: str) -> Any:
+        return self.raw[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.raw
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.raw.get(key, default)
+
+    # ------------------------------------------------------------- shorthands
+    @property
+    def type(self) -> str:
+        return self.raw["type"]
+
+    @property
+    def is_image(self) -> bool:
+        return self.type in IMAGE_TYPES
+
+    @property
+    def aggregation(self) -> str:
+        return self.raw["aggregation_methods"]
+
+    @property
+    def adversary_list(self) -> List[Any]:
+        return list(self.raw["adversary_list"])
+
+    @property
+    def num_adversaries(self) -> int:
+        return len(self.raw["adversary_list"])
+
+    @property
+    def is_centralized_attack(self) -> bool:
+        # A single adversary means "centralized" mode: it stamps the *global*
+        # (combined) pattern instead of a per-adversary sub-pattern
+        # (reference image_train.py:47-48, main.py:225-231).
+        return self.num_adversaries == 1
+
+    # ------------------------------------------------- per-adversary accessors
+    def is_adversary(self, agent_name: Any) -> bool:
+        return agent_name in self.raw["adversary_list"]
+
+    def adversary_slot_of(self, agent_name: Any) -> int:
+        """Position of `agent_name` in adversary_list, or -1 if benign.
+
+        The *slot* keys the poison schedule (``{slot}_poison_epochs``) even in
+        centralized mode — the reference resolves the schedule before forcing
+        the pattern index to -1 (image_train.py:38-48).
+        """
+        try:
+            return self.adversary_list.index(agent_name)
+        except ValueError:
+            return -1
+
+    def adversarial_index_of(self, agent_name: Any) -> int:
+        """Trigger-pattern index for `agent_name`: its slot, or -1 for benign
+        agents AND for the lone attacker in centralized mode, which trains on
+        the combined/global pattern (image_train.py:47-48). Use
+        :meth:`is_adversary` to distinguish the two -1 cases.
+        """
+        idx = self.adversary_slot_of(agent_name)
+        if idx >= 0 and self.is_centralized_attack:
+            return -1
+        return idx
+
+    def poison_epochs_for(self, adv_slot: int) -> List[int]:
+        """Poison schedule for adversary slot `adv_slot` (``{slot}_poison_epochs``).
+
+        Falls back to the global ``poison_epochs`` list like the reference does
+        for agents without a per-slot schedule (image_train.py:38-43).
+        """
+        if adv_slot >= 0:
+            key = f"{adv_slot}_poison_epochs"
+            if key in self.raw:
+                return list(self.raw[key])
+        return list(self.raw["poison_epochs"])
+
+    def poison_pattern_for(self, adv_index: int) -> List[List[int]]:
+        """Pixel trigger for adversary slot; -1 = union of all sub-patterns
+        (reference image_helper.py:328-335)."""
+        if adv_index == -1:
+            pattern: List[List[int]] = []
+            for i in range(int(self.raw["trigger_num"])):
+                pattern.extend(self.raw[f"{i}_poison_pattern"])
+            return pattern
+        return list(self.raw[f"{adv_index}_poison_pattern"])
+
+    def poison_trigger_features_for(self, adv_index: int):
+        """LOAN feature trigger (names, values) for slot; -1 = all concatenated
+        (reference loan_train.py:47-57)."""
+        names: List[str] = []
+        values: List[float] = []
+        if adv_index == -1:
+            for i in range(int(self.raw["trigger_num"])):
+                names.extend(self.raw[f"{i}_poison_trigger_names"])
+                values.extend(self.raw[f"{i}_poison_trigger_values"])
+        else:
+            names = list(self.raw[f"{adv_index}_poison_trigger_names"])
+            values = list(self.raw[f"{adv_index}_poison_trigger_values"])
+        return names, values
+
+    def scheduled_adversaries(self, epochs: Sequence[int]) -> List[Any]:
+        """Adversaries whose poison schedule intersects `epochs`
+        (reference main.py:149-154)."""
+        out = []
+        for idx, name in enumerate(self.adversary_list):
+            sched = self.poison_epochs_for(idx)
+            if any(e in sched for e in epochs):
+                out.append(name)
+        return out
+
+    # ---------------------------------------------------------------- run dir
+    def make_run_folder(self) -> Path:
+        folder = Path(self.raw["run_dir"]) / f"{self.type}_{self.current_time}"
+        folder.mkdir(parents=True, exist_ok=True)
+        with open(folder / "params.yaml", "w") as f:
+            yaml.dump(self.raw, f)
+        return folder

@@ -1,0 +1,101 @@
+"""Model registry.
+
+Maps the reference's four workloads (reference main.py:94-109) to Flax modules and
+records the per-model metadata the framework needs:
+
+- `similarity_path`: which parameter stands in for the reference FoolsGold's
+  "second-to-last named parameter" (helper.py:537) — for every reference model
+  that is the final linear layer's weight;
+- `has_batch_stats` / `has_dropout`: which extra variable collections / RNG
+  streams the train step must thread.
+
+Models are pure architectures; the reference's visdom-plotting mixin
+(models/simple.py:18-200) is deliberately not carried over (observability lives in
+`dba_mod_tpu.utils`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.models.loan import LoanNet
+from dba_mod_tpu.models.mnist import MnistNet
+from dba_mod_tpu.models.resnet import cifar_resnet18, tiny_resnet18
+
+
+class ModelVars(NamedTuple):
+    """A model's full mutable state: trainable params + BN running stats.
+
+    This is the functional equivalent of a torch ``state_dict`` — the unit that
+    clients perturb and the server aggregates (the reference averages BN buffers
+    together with weights, helper.py:233-257; we preserve that).
+    """
+    params: Any
+    batch_stats: Any  # empty dict for models without BN
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDef:
+    name: str
+    module: nn.Module
+    input_shape: Tuple[int, ...]   # one sample, NHWC / features
+    num_classes: int
+    similarity_path: Tuple[str, ...]
+    has_batch_stats: bool
+    has_dropout: bool
+
+    def init_vars(self, rng: jax.Array) -> ModelVars:
+        dummy = jnp.zeros((1,) + self.input_shape, jnp.float32)
+        variables = self.module.init(rng, dummy, train=False)
+        return ModelVars(params=variables["params"],
+                         batch_stats=variables.get("batch_stats", {}))
+
+    def apply(self, model_vars: ModelVars, x, train: bool,
+              dropout_rng: jax.Array | None = None):
+        """Forward pass. In train mode returns (logits, new_batch_stats)."""
+        variables = {"params": model_vars.params}
+        if self.has_batch_stats:
+            variables["batch_stats"] = model_vars.batch_stats
+        rngs = {"dropout": dropout_rng} if (self.has_dropout and train) else None
+        if train and self.has_batch_stats:
+            logits, updates = self.module.apply(
+                variables, x, train=True, rngs=rngs, mutable=["batch_stats"])
+            return logits, updates["batch_stats"]
+        logits = self.module.apply(variables, x, train=train, rngs=rngs)
+        return logits, model_vars.batch_stats
+
+    def similarity_param(self, params) -> jax.Array:
+        p = params
+        for k in self.similarity_path:
+            p = p[k]
+        return p
+
+
+def build_model(params: cfg.Params) -> ModelDef:
+    t = params.type
+    if t == cfg.TYPE_MNIST:
+        return ModelDef(name="MnistNet", module=MnistNet(),
+                        input_shape=(28, 28, 1), num_classes=10,
+                        similarity_path=("Dense_1", "kernel"),
+                        has_batch_stats=False, has_dropout=False)
+    if t == cfg.TYPE_CIFAR:
+        return ModelDef(name="CifarResNet18", module=cifar_resnet18(),
+                        input_shape=(32, 32, 3), num_classes=10,
+                        similarity_path=("Dense_0", "kernel"),
+                        has_batch_stats=True, has_dropout=False)
+    if t == cfg.TYPE_TINYIMAGENET:
+        return ModelDef(name="TinyResNet18", module=tiny_resnet18(),
+                        input_shape=(64, 64, 3), num_classes=200,
+                        similarity_path=("Dense_0", "kernel"),
+                        has_batch_stats=True, has_dropout=False)
+    if t == cfg.TYPE_LOAN:
+        return ModelDef(name="LoanNet", module=LoanNet(),
+                        input_shape=(91,), num_classes=9,
+                        similarity_path=("Dense_2", "kernel"),
+                        has_batch_stats=False, has_dropout=True)
+    raise ValueError(f"unknown workload type {t!r}")

@@ -1,0 +1,35 @@
+"""LOAN tabular MLP.
+
+Capability parity with reference `models/loan_model.py:10-27`: 91 → 46 → 23 → 9
+with Dropout(0.5) *before* ReLU on each hidden layer (the reference's Sequential
+order is Linear → Dropout → ReLU), raw logits out. The reference's host-side NaN
+guard (loan_model.py:25-26) is replaced by `dba_mod_tpu.fl` debug-mode checks —
+a data-dependent Python raise can't live inside a jitted forward.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+
+from dba_mod_tpu.ops.initializers import torch_bias_init, torch_kaiming_uniform
+
+
+class LoanNet(nn.Module):
+    in_dim: int = 91
+    hidden1: int = 46
+    hidden2: int = 23
+    num_classes: int = 9
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(self.hidden1, kernel_init=torch_kaiming_uniform,
+                     bias_init=torch_bias_init(self.in_dim))(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden2, kernel_init=torch_kaiming_uniform,
+                     bias_init=torch_bias_init(self.hidden1))(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, kernel_init=torch_kaiming_uniform,
+                     bias_init=torch_bias_init(self.hidden2))(x)
+        return x

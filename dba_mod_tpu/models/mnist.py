@@ -1,0 +1,43 @@
+"""LeNet-style MNIST classifier.
+
+Capability parity with reference `models/MnistNet.py:7-33`: conv(1→20, 5×5, valid)
+→ maxpool2 → conv(20→50, 5×5, valid) → maxpool2 → fc(800→500) → fc(500→10),
+log_softmax output. Layout is NHWC (TPU-native); the flatten order therefore
+differs from torch's NCHW `.view`, which is a pure re-parameterisation with no
+effect on the function class.
+
+The reference feeds the log_softmax output into F.cross_entropy (MnistNet.py:31 →
+image_train.py:85); since log_softmax is idempotent under another log_softmax this
+equals training on logits — we keep the log_softmax head for output parity.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dba_mod_tpu.ops.initializers import torch_bias_init, torch_kaiming_uniform
+
+
+class MnistNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [N, 28, 28, 1]
+        x = nn.Conv(20, (5, 5), padding="VALID",
+                    kernel_init=torch_kaiming_uniform,
+                    bias_init=torch_bias_init(1 * 5 * 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(50, (5, 5), padding="VALID",
+                    kernel_init=torch_kaiming_uniform,
+                    bias_init=torch_bias_init(20 * 5 * 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # [N, 4*4*50]
+        x = nn.Dense(500, kernel_init=torch_kaiming_uniform,
+                     bias_init=torch_bias_init(800))(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, kernel_init=torch_kaiming_uniform,
+                     bias_init=torch_bias_init(500))(x)
+        return nn.log_softmax(x, axis=-1)

@@ -1,0 +1,154 @@
+"""ResNet family: the narrow CIFAR variant and the torchvision-style Tiny-ImageNet
+variant.
+
+Capability parity:
+- `cifar_resnet18()` matches reference `models/resnet_cifar.py:70-116`: 3×3 stem,
+  **narrow widths (32/64/128/256)** — not the standard 64-base ResNet — BasicBlock
+  [2,2,2,2], 4×4 average pool, linear head, raw logits. torch-default inits.
+- `tiny_resnet18()` matches reference `models/resnet_tinyimagenet.py:40-238`:
+  standard 64-base torchvision ResNet-18 with a 7×7/stride-2 stem, 3×3 max pool,
+  global average pool, 200-class head, kaiming_normal(fan_out) conv init and
+  BN γ=1/β=0 (reference :158-163).
+
+Layout is NHWC, BatchNorm carries running stats in the `batch_stats` collection
+(torch momentum 0.1 ≙ flax momentum 0.9, eps 1e-5). Deeper variants
+(ResNet-34/50/101/152, reference resnet_cifar.py:106-116) are exposed through the
+same constructors via `num_blocks`/`bottleneck`.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dba_mod_tpu.ops.initializers import (kaiming_normal_fan_out,
+                                          torch_bias_init,
+                                          torch_kaiming_uniform)
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding=((1, 1), (1, 1)), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.planes, (3, 3), strides=(1, 1),
+                      padding=((1, 1), (1, 1)), use_bias=False)(y)
+        y = self.norm()(y)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            residual = self.conv(self.planes, (1, 1),
+                                 strides=(self.stride, self.stride),
+                                 use_bias=False)(x)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_planes = self.planes * self.expansion
+        residual = x
+        y = self.conv(self.planes, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding=((1, 1), (1, 1)), use_bias=False)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(out_planes, (1, 1), use_bias=False)(y)
+        y = self.norm()(y)
+        if self.stride != 1 or x.shape[-1] != out_planes:
+            residual = self.conv(out_planes, (1, 1),
+                                 strides=(self.stride, self.stride),
+                                 use_bias=False)(x)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet covering both reference variants."""
+
+    num_classes: int
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+    widths: Sequence[int] = (32, 64, 128, 256)   # narrow CIFAR widths
+    bottleneck: bool = False
+    stem: str = "cifar"                          # "cifar": 3x3/s1; "imagenet": 7x7/s2+maxpool
+    pool: str = "avg4"                           # "avg4": 4x4 window; "global"
+    kernel_init: Callable = torch_kaiming_uniform
+    head_init: Tuple[Callable, Callable] | None = None  # (kernel_init, bias_init)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, kernel_init=self.kernel_init)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        block_cls = Bottleneck if self.bottleneck else BasicBlock
+
+        if self.stem == "cifar":
+            x = conv(self.widths[0], (3, 3), padding=((1, 1), (1, 1)),
+                     use_bias=False)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.widths[0], (7, 7), strides=(2, 2),
+                     padding=((3, 3), (3, 3)), use_bias=False)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for stage, (planes, blocks) in enumerate(zip(self.widths, self.num_blocks)):
+            for i in range(blocks):
+                stride = (2 if stage > 0 else 1) if i == 0 else 1
+                x = block_cls(planes=planes, stride=stride,
+                              conv=conv, norm=norm)(x)
+
+        if self.pool == "avg4":
+            x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        else:
+            x = jnp.mean(x, axis=(1, 2), keepdims=True)
+        x = x.reshape((x.shape[0], -1))
+
+        feat = x.shape[-1]
+        k_init, b_init = (self.head_init if self.head_init is not None
+                          else (torch_kaiming_uniform, torch_bias_init(feat)))
+        x = nn.Dense(self.num_classes, kernel_init=k_init, bias_init=b_init)(x)
+        return x
+
+
+def cifar_resnet18(num_classes: int = 10) -> ResNet:
+    return ResNet(num_classes=num_classes, num_blocks=(2, 2, 2, 2),
+                  widths=(32, 64, 128, 256), stem="cifar", pool="avg4")
+
+
+def cifar_resnet34(num_classes: int = 10) -> ResNet:
+    return ResNet(num_classes=num_classes, num_blocks=(3, 4, 6, 3),
+                  widths=(32, 64, 128, 256), stem="cifar", pool="avg4")
+
+
+def cifar_resnet50(num_classes: int = 10) -> ResNet:
+    return ResNet(num_classes=num_classes, num_blocks=(3, 4, 6, 3),
+                  widths=(32, 64, 128, 256), bottleneck=True,
+                  stem="cifar", pool="avg4")
+
+
+def tiny_resnet18(num_classes: int = 200) -> ResNet:
+    return ResNet(num_classes=num_classes, num_blocks=(2, 2, 2, 2),
+                  widths=(64, 128, 256, 512), stem="imagenet", pool="global",
+                  kernel_init=kaiming_normal_fan_out)

@@ -1,0 +1,5 @@
+"""Numeric building blocks: initializers, optimizers, triggers, aggregation math.
+
+Everything in this package is pure jax/jnp (host-free, jit-safe); orchestration
+lives in `dba_mod_tpu.fl`.
+"""

@@ -1,0 +1,34 @@
+"""Parameter initializers matching torch defaults.
+
+The reference models rely on two init regimes:
+- torch's default ``nn.Conv2d``/``nn.Linear`` init: kaiming_uniform(a=sqrt(5)) for
+  the weight, which reduces to U(-1/sqrt(fan_in), 1/sqrt(fan_in)), and the same
+  bound for the bias (used by MnistNet.py, resnet_cifar.py, loan_model.py);
+- explicit kaiming_normal(fan_out, relu) + BN(weight=1, bias=0)
+  (resnet_tinyimagenet.py:158-163).
+
+Matching the init distribution keeps our training curves statistically comparable
+to the reference's.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import random
+from jax.nn.initializers import variance_scaling
+
+# U(-1/sqrt(fan_in), 1/sqrt(fan_in)): variance_scaling draws
+# U(-sqrt(3*scale/fan_in), +sqrt(3*scale/fan_in)); scale=1/3 gives the torch bound.
+torch_kaiming_uniform = variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+# kaiming_normal(mode=fan_out, nonlinearity=relu): N(0, sqrt(2/fan_out)).
+kaiming_normal_fan_out = variance_scaling(2.0, "fan_out", "normal")
+
+
+def torch_bias_init(fan_in: int):
+    """torch Linear/Conv bias default: U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    bound = 1.0 / (fan_in ** 0.5) if fan_in > 0 else 0.0
+
+    def init(key, shape, dtype=jnp.float32):
+        return random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init

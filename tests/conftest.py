@@ -1,0 +1,12 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-device tests exercise the `clients` mesh axis without TPU hardware — the
+TPU-world equivalent of a fake backend (SURVEY.md §4). Must run before jax
+initializes a backend, hence module-level in conftest.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

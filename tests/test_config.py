@@ -1,0 +1,88 @@
+"""Config loader tests: reference-schema ingestion and per-adversary accessors."""
+import pytest
+
+from dba_mod_tpu import config as cfg
+
+
+BASE = {
+    "type": "cifar", "lr": 0.1, "batch_size": 64, "epochs": 10,
+    "no_models": 10, "number_of_total_participants": 100, "eta": 0.1,
+    "aggregation_methods": "mean",
+    "adversary_list": [17, 33, 77, 11],
+    "trigger_num": 4,
+    "0_poison_pattern": [[0, 0], [0, 1]],
+    "1_poison_pattern": [[0, 9], [0, 10]],
+    "2_poison_pattern": [[4, 0], [4, 1]],
+    "3_poison_pattern": [[4, 9], [4, 10]],
+    "0_poison_epochs": [3],
+    "1_poison_epochs": [5],
+    "2_poison_epochs": [7],
+    "3_poison_epochs": [9],
+    "poison_epochs": [1],
+}
+
+
+def test_required_key_validation():
+    with pytest.raises(ValueError, match="missing required"):
+        cfg.Params.from_dict({"type": "cifar"})
+
+
+def test_unknown_aggregation_rejected():
+    bad = dict(BASE, aggregation_methods="krum")
+    with pytest.raises(ValueError, match="aggregation"):
+        cfg.Params.from_dict(bad)
+
+
+def test_adversarial_index_distributed():
+    p = cfg.Params.from_dict(BASE)
+    assert p.adversarial_index_of(33) == 1
+    assert p.adversarial_index_of(5) == -1
+    assert not p.is_centralized_attack
+
+
+def test_adversarial_index_centralized_forces_global_pattern():
+    # single adversary => pattern index -1 => combined pattern
+    # (image_train.py:47-48), but the SCHEDULE still keys on slot 0
+    # (resolved before the -1 is forced, image_train.py:38-48)
+    p = cfg.Params.from_dict(dict(BASE, adversary_list=[45]))
+    assert p.is_centralized_attack
+    assert p.adversarial_index_of(45) == -1
+    assert p.is_adversary(45) and not p.is_adversary(999)
+    assert p.adversary_slot_of(45) == 0
+    assert p.poison_epochs_for(p.adversary_slot_of(45)) == [3]
+
+
+def test_defaults_not_shared_across_instances():
+    p1 = cfg.Params.from_dict(dict(BASE))
+    p1.raw["save_on_epochs"].append(42)
+    p2 = cfg.Params.from_dict(dict(BASE))
+    assert 42 not in p2.raw["save_on_epochs"]
+
+
+def test_pattern_union():
+    p = cfg.Params.from_dict(BASE)
+    assert p.poison_pattern_for(2) == [[4, 0], [4, 1]]
+    combined = p.poison_pattern_for(-1)
+    assert len(combined) == 8 and [0, 9] in combined and [4, 10] in combined
+
+
+def test_poison_epochs_fallback_to_global():
+    raw = dict(BASE)
+    del raw["2_poison_epochs"]
+    p = cfg.Params.from_dict(raw)
+    assert p.poison_epochs_for(2) == [1]
+    assert p.poison_epochs_for(0) == [3]
+
+
+def test_scheduled_adversaries():
+    p = cfg.Params.from_dict(BASE)
+    assert p.scheduled_adversaries([5]) == [33]
+    assert p.scheduled_adversaries([3, 4, 5]) == [17, 33]
+    assert p.scheduled_adversaries([100]) == []
+
+
+def test_defaults_fill_in():
+    p = cfg.Params.from_dict(BASE)
+    assert p["momentum"] == 0.9
+    assert p["fg_use_memory"] is True
+    assert p["is_poison"] is False
