@@ -178,13 +178,13 @@ class Params:
     def poison_epochs_for(self, adv_slot: int) -> List[int]:
         """Poison schedule for adversary slot `adv_slot` (``{slot}_poison_epochs``).
 
-        Falls back to the global ``poison_epochs`` list like the reference does
-        for agents without a per-slot schedule (image_train.py:38-43).
+        A missing per-slot key for a real adversary slot is a config error and
+        raises KeyError, matching the reference's unconditional lookup
+        (image_train.py:43, main.py:151); the global ``poison_epochs`` list is
+        only the benign-agent default (image_train.py:38).
         """
         if adv_slot >= 0:
-            key = f"{adv_slot}_poison_epochs"
-            if key in self.raw:
-                return list(self.raw[key])
+            return list(self.raw[f"{adv_slot}_poison_epochs"])
         return list(self.raw["poison_epochs"])
 
     def poison_pattern_for(self, adv_index: int) -> List[List[int]]:

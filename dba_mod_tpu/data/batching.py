@@ -1,0 +1,90 @@
+"""Batch plans: precomputed index tensors driving device-resident gathers.
+
+The reference's DataLoader+SubsetRandomSampler reshuffles each client's subset
+every internal epoch and yields a partial final batch (image_helper.py:252-263,
+drop_last=False). The TPU-native equivalent precomputes, per round, an index
+tensor [clients, epochs, steps, batch] plus a validity mask; the jitted client
+step gathers rows straight from the device-resident dataset — the host ships
+only these small int32 plans each round.
+
+Shuffling uses per-client numpy RNG rather than the reference's global torch
+RNG: the sequential loop's RNG stream is inherently irreproducible under
+parallel clients, so parity here is statistical (SURVEY §7.2.4).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """One round's data access plan for the stacked client step."""
+    idx: np.ndarray        # [C, E, S, B] int32 indices into the dataset
+    mask: np.ndarray       # [C, E, S, B] bool — valid (non-padding) samples
+    num_samples: np.ndarray  # [C] int32 — true per-client dataset sizes
+    num_epochs: np.ndarray   # [C] int32 — per-client internal-epoch counts
+
+
+@dataclasses.dataclass
+class EvalPlan:
+    idx: np.ndarray        # [S, B] int32
+    mask: np.ndarray       # [S, B] bool
+
+
+def build_batch_plan(client_indices: Sequence[Sequence[int]],
+                     client_epochs: Sequence[int], batch_size: int,
+                     rng: np.random.RandomState,
+                     min_steps: int = 1) -> BatchPlan:
+    """Build the [C, E, S, B] plan. E = max(client_epochs); clients with fewer
+    epochs get fully-masked rows beyond their count. Every epoch reshuffles
+    each client's subset (SubsetRandomSampler semantics). Empty clients are
+    fully masked."""
+    C = len(client_indices)
+    E = max(1, max(client_epochs, default=1))
+    sizes = np.array([len(ix) for ix in client_indices], np.int32)
+    S = max(min_steps, int(np.ceil(sizes.max() / batch_size)) if sizes.max() else min_steps)
+    idx = np.zeros((C, E, S, batch_size), np.int64)
+    mask = np.zeros((C, E, S, batch_size), bool)
+    for c, indices in enumerate(client_indices):
+        n = len(indices)
+        if n == 0:
+            continue
+        arr = np.asarray(indices, np.int64)
+        for e in range(min(int(client_epochs[c]), E) if client_epochs[c] else 0):
+            shuffled = arr[rng.permutation(n)]
+            padded = np.zeros((S * batch_size,), np.int64)
+            padded[:n] = shuffled
+            idx[c, e] = padded.reshape(S, batch_size)
+            m = np.zeros((S * batch_size,), bool)
+            m[:n] = True
+            mask[c, e] = m.reshape(S, batch_size)
+    return BatchPlan(idx=idx.astype(np.int32), mask=mask, num_samples=sizes,
+                     num_epochs=np.asarray(client_epochs, np.int32))
+
+
+def build_eval_plan(indices: np.ndarray, batch_size: int) -> EvalPlan:
+    """Sequential padded batches over `indices` (test loaders iterate the full
+    set once; order is irrelevant to the accuracy sums — test.py:29-37)."""
+    n = len(indices)
+    S = max(1, int(np.ceil(n / batch_size)))
+    idx = np.zeros((S * batch_size,), np.int64)
+    idx[:n] = np.asarray(indices, np.int64)
+    mask = np.zeros((S * batch_size,), bool)
+    mask[:n] = True
+    return EvalPlan(idx=idx.reshape(S, batch_size).astype(np.int32),
+                    mask=mask.reshape(S, batch_size))
+
+
+def stack_ragged(arrays: List[np.ndarray], pad_value=0) -> np.ndarray:
+    """Stack per-client ragged arrays into [C, max_n, ...] with padding —
+    used for LOAN per-state shards."""
+    C = len(arrays)
+    max_n = max(a.shape[0] for a in arrays)
+    out = np.full((C, max_n) + arrays[0].shape[1:], pad_value,
+                  arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        out[i, :a.shape[0]] = a
+    return out

@@ -1,0 +1,271 @@
+"""Dataset ingestion: raw files when present, deterministic synthetic fallback.
+
+The reference downloads via torchvision (image_helper.py:186-219) and reads
+LOAN per-state CSVs produced by its ETL (loan_helper.py:111-132,
+utils/loan_preprocess.py). This module reads the same on-disk artifacts
+directly (idx/pickle/folder/CSV — no torch dependency in the data path) and,
+when the files are absent, generates a *deterministic synthetic* stand-in with
+the same shapes/class counts so every pipeline stage runs anywhere. Pixel
+values match the reference's ToTensor() range [0,1] (no normalization —
+image_helper.py:178-201); images are stored uint8 host-side and scaled on
+device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import pickle
+import struct
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+
+
+@dataclasses.dataclass
+class ImageData:
+    """Host-side image classification data. Images uint8 NHWC in [0,255]."""
+    train_images: np.ndarray
+    train_labels: np.ndarray
+    test_images: np.ndarray
+    test_labels: np.ndarray
+    num_classes: int
+    synthetic: bool = False
+
+
+@dataclasses.dataclass
+class LoanData:
+    """Host-side LOAN data: one shard per US state (natural non-IID clients,
+    loan_helper.py:119-132). 80/20 train/test split per shard with
+    sklearn(random_state=42) parity (loan_helper.py:172)."""
+    state_names: List[str]
+    train_x: List[np.ndarray]   # per state, [N_s, F] float32
+    train_y: List[np.ndarray]
+    test_x: List[np.ndarray]
+    test_y: List[np.ndarray]
+    feature_names: List[str]
+    num_classes: int = 9
+    synthetic: bool = False
+
+    @property
+    def feature_dict(self) -> Dict[str, int]:
+        return {n: i for i, n in enumerate(self.feature_names)}
+
+
+# ---------------------------------------------------------------------- MNIST
+def _read_idx(path: Path) -> np.ndarray:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">I", f.read(4))
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def _find(dirs: List[Path], names: List[str]) -> Optional[Path]:
+    for d in dirs:
+        for n in names:
+            for cand in (d / n, d / (n + ".gz")):
+                if cand.exists():
+                    return cand
+    return None
+
+
+def load_mnist(data_dir: str) -> Optional[ImageData]:
+    root = Path(data_dir)
+    dirs = [root, root / "MNIST" / "raw", root / "mnist"]
+    files = {
+        "train_x": ["train-images-idx3-ubyte"],
+        "train_y": ["train-labels-idx1-ubyte"],
+        "test_x": ["t10k-images-idx3-ubyte"],
+        "test_y": ["t10k-labels-idx1-ubyte"],
+    }
+    paths = {k: _find(dirs, v) for k, v in files.items()}
+    if any(p is None for p in paths.values()):
+        return None
+    return ImageData(
+        train_images=_read_idx(paths["train_x"])[..., None],
+        train_labels=_read_idx(paths["train_y"]).astype(np.int32),
+        test_images=_read_idx(paths["test_x"])[..., None],
+        test_labels=_read_idx(paths["test_y"]).astype(np.int32),
+        num_classes=10)
+
+
+# --------------------------------------------------------------------- CIFAR10
+def load_cifar10(data_dir: str) -> Optional[ImageData]:
+    root = Path(data_dir) / "cifar-10-batches-py"
+    if not root.exists():
+        return None
+
+    def read_batch(name):
+        with open(root / name, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        imgs = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return imgs, np.array(d[b"labels"], np.int32)
+
+    xs, ys = zip(*[read_batch(f"data_batch_{i}") for i in range(1, 6)])
+    test_x, test_y = read_batch("test_batch")
+    return ImageData(np.concatenate(xs), np.concatenate(ys), test_x, test_y,
+                     num_classes=10)
+
+
+# -------------------------------------------------------------- Tiny-ImageNet
+def load_tiny_imagenet(data_dir: str) -> Optional[ImageData]:
+    """Reads the post-ETL layout (train/<wnid>/images/*.JPEG + reformatted
+    val/<wnid>/*), or a prebuilt `tiny-imagenet-200.npz` cache. JPEG decoding
+    needs PIL; building the npz cache once via
+    `python -m dba_mod_tpu.main cache-tiny` is the fast path."""
+    root = Path(data_dir) / "tiny-imagenet-200"
+    npz = root.with_suffix(".npz")
+    if npz.exists():
+        z = np.load(npz)
+        return ImageData(z["train_x"], z["train_y"].astype(np.int32),
+                         z["test_x"], z["test_y"].astype(np.int32), 200)
+    if not (root / "train").exists():
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+    wnids = sorted(p.name for p in (root / "train").iterdir() if p.is_dir())
+    cls = {w: i for i, w in enumerate(wnids)}
+
+    def read_split(split_dir: Path):
+        xs, ys = [], []
+        for wnid_dir in sorted(split_dir.iterdir()):
+            if not wnid_dir.is_dir() or wnid_dir.name not in cls:
+                continue
+            img_dir = wnid_dir / "images" if (wnid_dir / "images").exists() else wnid_dir
+            for img_path in sorted(img_dir.glob("*.JPEG")):
+                img = np.asarray(Image.open(img_path).convert("RGB"), np.uint8)
+                xs.append(img)
+                ys.append(cls[wnid_dir.name])
+        return np.stack(xs), np.array(ys, np.int32)
+
+    train_x, train_y = read_split(root / "train")
+    test_x, test_y = read_split(root / "val")
+    return ImageData(train_x, train_y, test_x, test_y, 200)
+
+
+# ------------------------------------------------------------------ synthetic
+_IMAGE_SHAPES = {cfg.TYPE_MNIST: (28, 28, 1, 10),
+                 cfg.TYPE_CIFAR: (32, 32, 3, 10),
+                 cfg.TYPE_TINYIMAGENET: (64, 64, 3, 200)}
+
+
+def synthetic_image_dataset(dtype: str, train_size: int = 0,
+                            test_size: int = 0, seed: int = 0) -> ImageData:
+    """Deterministic learnable stand-in: per-class low-frequency template +
+    noise, labels balanced. Sized like the real dataset unless overridden."""
+    h, w, c, ncls = _IMAGE_SHAPES[dtype]
+    defaults = {cfg.TYPE_MNIST: (60000, 10000), cfg.TYPE_CIFAR: (50000, 10000),
+                cfg.TYPE_TINYIMAGENET: (100000, 10000)}
+    n_train = train_size or defaults[dtype][0]
+    n_test = test_size or defaults[dtype][1]
+    rng = np.random.RandomState(seed)
+    templates = rng.randint(40, 216, size=(ncls, h, w, c)).astype(np.float32)
+
+    def make(n, rng):
+        labels = rng.randint(0, ncls, size=n).astype(np.int32)
+        noise = rng.randn(n, h, w, c).astype(np.float32) * 25.0
+        imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
+        return imgs, labels
+
+    train_x, train_y = make(n_train, rng)
+    test_x, test_y = make(n_test, np.random.RandomState(seed + 1))
+    return ImageData(train_x, train_y, test_x, test_y, ncls, synthetic=True)
+
+
+_US_STATES = ["AK", "AL", "AR", "AZ", "CA", "CO", "CT", "DC", "DE", "FL", "GA",
+              "HI", "IA", "ID", "IL", "IN", "KS", "KY", "LA", "MA", "MD", "ME",
+              "MI", "MN", "MO", "MS", "MT", "NC", "ND", "NE", "NH", "NJ", "NM",
+              "NV", "NY", "OH", "OK", "OR", "PA", "RI", "SC", "SD", "TN", "TX",
+              "UT", "VA", "VT", "WA", "WI", "WV", "WY"]
+
+# Feature names used by the reference LOAN trigger configs
+# (utils/loan_params.yaml:31-36) must exist in the synthetic schema.
+_LOAN_TRIGGER_FEATURES = ["num_tl_120dpd_2m", "num_tl_90g_dpd_24m",
+                          "pub_rec_bankruptcies", "pub_rec", "acc_now_delinq",
+                          "tax_liens", "out_prncp", "total_pymnt_inv",
+                          "out_prncp_inv", "total_rec_prncp",
+                          "last_pymnt_amnt", "all_util"]
+
+
+def synthetic_loan_dataset(num_states: int = 51, num_features: int = 91,
+                           rows_per_state: int = 800,
+                           seed: int = 0) -> LoanData:
+    """Synthetic LOAN: 9-class labels correlated with features through a fixed
+    random linear map, per-state row counts varied deterministically."""
+    feature_names = list(_LOAN_TRIGGER_FEATURES)
+    feature_names += [f"feat_{i}" for i in range(num_features - len(feature_names))]
+    rng = np.random.RandomState(seed)
+    proj = rng.randn(num_features, 9).astype(np.float32)
+    names, tx, ty, sx, sy = [], [], [], [], []
+    for s in range(num_states):
+        n = rows_per_state + (s * 37) % 400
+        x = rng.randn(n, num_features).astype(np.float32)
+        logits = x @ proj + rng.randn(n, 9).astype(np.float32)
+        y = np.argmax(logits, axis=1).astype(np.int32)
+        k = max(1, int(0.8 * n))
+        names.append(_US_STATES[s % len(_US_STATES)])
+        tx.append(x[:k]); ty.append(y[:k]); sx.append(x[k:]); sy.append(y[k:])
+    return LoanData(names, tx, ty, sx, sy, feature_names, synthetic=True)
+
+
+def load_loan_csvs(data_dir: str) -> Optional[LoanData]:
+    """Per-state CSVs from the LOAN ETL (utils/loan_preprocess.py:49-56; files
+    named loan_<STATE>.csv with a `loan_status` label column). Split 80/20 with
+    sklearn random_state=42 for parity with LoanDataset (loan_helper.py:172)."""
+    root = Path(data_dir) / "loan"
+    if not root.exists():
+        return None
+    try:
+        import pandas as pd
+        from sklearn.model_selection import train_test_split
+    except ImportError:
+        return None
+    files = sorted(root.glob("loan_*.csv"))
+    if not files:
+        return None
+    names, tx, ty, sx, sy, feature_names = [], [], [], [], [], None
+    for f in files:
+        df = pd.read_csv(f)
+        x_cols = [c for c in df.columns if c != "loan_status"]
+        if feature_names is None:
+            feature_names = x_cols
+        x = df[x_cols].values.astype(np.float32)
+        y = df["loan_status"].values.astype(np.int32)
+        x_tr, x_te, y_tr, y_te = train_test_split(x, y, test_size=0.2,
+                                                  random_state=42)
+        names.append(f.stem[5:7])
+        tx.append(x_tr); ty.append(y_tr); sx.append(x_te); sy.append(y_te)
+    return LoanData(names, tx, ty, sx, sy, feature_names)
+
+
+# ------------------------------------------------------------------ dispatch
+def load_image_dataset(params: cfg.Params) -> ImageData:
+    t = params.type
+    data = None
+    if not params.get("synthetic_data", False):
+        loader = {cfg.TYPE_MNIST: load_mnist, cfg.TYPE_CIFAR: load_cifar10,
+                  cfg.TYPE_TINYIMAGENET: load_tiny_imagenet}[t]
+        data = loader(params.get("data_dir", "./data"))
+    if data is None:
+        data = synthetic_image_dataset(
+            t, train_size=int(params.get("synthetic_train_size", 0) or 0),
+            seed=int(params.get("random_seed", 1)))
+    return data
+
+
+def load_loan_dataset(params: cfg.Params) -> LoanData:
+    data = None
+    if not params.get("synthetic_data", False):
+        data = load_loan_csvs(params.get("data_dir", "./data"))
+    if data is None:
+        data = synthetic_loan_dataset(
+            num_states=max(51, int(params["number_of_total_participants"])),
+            seed=int(params.get("random_seed", 1)))
+    return data

@@ -61,6 +61,9 @@ class ModelDef:
         variables = {"params": model_vars.params}
         if self.has_batch_stats:
             variables["batch_stats"] = model_vars.batch_stats
+        if self.has_dropout and train and dropout_rng is None:
+            raise ValueError(
+                f"{self.name}: dropout_rng is required in train mode")
         rngs = {"dropout": dropout_rng} if (self.has_dropout and train) else None
         if train and self.has_batch_stats:
             logits, updates = self.module.apply(

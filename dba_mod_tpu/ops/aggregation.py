@@ -1,0 +1,239 @@
+"""Server aggregation rules as pure jnp programs over *stacked* client updates.
+
+Client updates arrive as a pytree whose leaves have a leading `clients` axis
+(the TPU-native replacement for the reference's per-client Python dicts,
+helper.py:193-231). Three rules, matching reference semantics:
+
+- FedAvg (`average_shrink_models`, helper.py:240-257): global += η/no_models ·
+  Σ_c Δ_c, applied to EVERY state entry (weights and BN stats alike), optional
+  DP gaussian noise (helper.py:186-191, :253-254). Note the reference divides
+  by `no_models`, not by Σ samples — unweighted; kept for parity.
+- RFA geometric median (`geometric_median_update`, helper.py:295-373):
+  Weiszfeld iterations with sample-count alphas, ftol early stop, oracle-call
+  count, optional update-norm rejection. The reference crashes when Weiszfeld
+  converges at iteration 0 (`wv=None` → `wv.cpu()`, helper.py:371); we fix it
+  by always reporting the most recent weights.
+- FoolsGold (`foolsgold_update`, helper.py:259-293 + class FoolsGold
+  :527-607): cosine-similarity reweighting over the second-to-last trainable
+  tensor's accumulated gradient, per-participant historical memory, pardoning,
+  logit re-weighting, applied through one torch-SGD step on trainable params
+  only.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.ops.sgd import sgd_step
+
+
+# ------------------------------------------------------------------- utilities
+def flatten_stacked(tree: Any) -> jax.Array:
+    """Flatten a client-stacked pytree ([C, ...] leaves) to a [C, P] matrix."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate(
+        [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves], axis=1)
+
+
+def unflatten_like(vec: jax.Array, tree: Any) -> Any:
+    """Inverse of :func:`flatten_stacked` for a single [P] vector, shaped like
+    one (un-stacked) element of `tree`."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, off = [], 0
+    for l in leaves:
+        shape = l.shape[1:]
+        size = 1
+        for s in shape:
+            size *= s
+        out.append(vec[off:off + size].reshape(shape).astype(l.dtype))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def dp_noise_like(rng: jax.Array, tree: Any, sigma: float) -> Any:
+    """Gaussian DP noise per state entry (helper.py:186-191)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [jax.random.normal(k, l.shape, jnp.float32) * sigma
+              for k, l in zip(keys, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+# --------------------------------------------------------------------- FedAvg
+def fedavg_update(global_state: Any, stacked_deltas: Any, eta: float,
+                  no_models: int, dp_sigma: float = 0.0,
+                  rng: jax.Array | None = None) -> Any:
+    """helper.py:240-257. `global_state` is the full model state (params + BN
+    stats); `stacked_deltas` has a leading clients axis over the same tree."""
+    scale = eta / no_models
+
+    def upd(g, d):
+        return (g + scale * jnp.sum(d, axis=0).astype(g.dtype)).astype(g.dtype)
+
+    new_state = jax.tree_util.tree_map(upd, global_state, stacked_deltas)
+    if dp_sigma and rng is not None:
+        noise = dp_noise_like(rng, new_state, dp_sigma)
+        new_state = jax.tree_util.tree_map(lambda s, n: s + n.astype(s.dtype),
+                                           new_state, noise)
+    return new_state
+
+
+# ------------------------------------------------------------- RFA / Weiszfeld
+class RfaResult(NamedTuple):
+    new_state: Any
+    num_oracle_calls: jax.Array   # int32
+    is_updated: jax.Array         # bool (norm rejection)
+    wv: jax.Array                 # [C] final Weiszfeld weights
+    distances: jax.Array          # [C] ‖median - Δ_c‖ (reference's out-alphas)
+
+
+def geometric_median_update(global_state: Any, stacked_deltas: Any,
+                            num_samples: jax.Array, eta: float,
+                            maxiter: int = 10, eps: float = 1e-5,
+                            ftol: float = 1e-6,
+                            max_update_norm: float | None = None,
+                            dp_sigma: float = 0.0,
+                            rng: jax.Array | None = None) -> RfaResult:
+    """Weiszfeld geometric median of client deltas (helper.py:295-373).
+
+    Runs the full `maxiter` iterations with a `done` mask emulating the
+    reference's ftol break — identical numerics, static XLA control flow.
+    """
+    points = flatten_stacked(stacked_deltas)                    # [C, P]
+    alphas = num_samples.astype(jnp.float32)
+    alphas = alphas / jnp.sum(alphas)
+
+    def wavg(w):
+        return (w / jnp.sum(w)) @ points                        # [P]
+
+    def objective(m):
+        return jnp.sum(alphas * jnp.linalg.norm(points - m[None, :], axis=1))
+
+    median0 = wavg(alphas)
+    obj0 = objective(median0)
+
+    def body(carry, _):
+        median, obj, wv, done, calls = carry
+        dist = jnp.linalg.norm(points - median[None, :], axis=1)
+        weights = alphas / jnp.maximum(eps, dist)
+        weights = weights / jnp.sum(weights)
+        new_median = wavg(weights)
+        new_obj = objective(new_median)
+        converged = jnp.abs(obj - new_obj) < ftol * new_obj
+        step_done = done | converged
+        # The reference records wv only on non-breaking iterations
+        # (helper.py:352) and crashes when none happened; we instead always
+        # keep the latest weights (the documented wv=None fix, SURVEY §7.2.8).
+        median = jnp.where(done, median, new_median)
+        obj = jnp.where(done, obj, new_obj)
+        wv = jnp.where(done, wv, weights)
+        calls = calls + jnp.where(done, 0, 1)
+        return (median, obj, wv, step_done, calls), None
+
+    init = (median0, obj0, alphas, jnp.asarray(False), jnp.int32(1))
+    (median, _obj, wv, _done, calls), _ = jax.lax.scan(
+        body, init, None, length=maxiter)
+
+    distances = jnp.linalg.norm(points - median[None, :], axis=1)
+    update_norm = jnp.linalg.norm(median)
+    is_updated = (jnp.asarray(True) if max_update_norm is None
+                  else update_norm < max_update_norm)
+
+    median_tree = unflatten_like(median * eta, stacked_deltas)
+    if dp_sigma and rng is not None:
+        noise = dp_noise_like(rng, median_tree, dp_sigma)
+        median_tree = jax.tree_util.tree_map(
+            lambda m, n: m + n.astype(m.dtype), median_tree, noise)
+
+    new_state = jax.tree_util.tree_map(
+        lambda g, u: jnp.where(is_updated, g + u.astype(g.dtype), g),
+        global_state, median_tree)
+    return RfaResult(new_state, calls, is_updated, wv, distances)
+
+
+# ----------------------------------------------------------------- FoolsGold
+class FoolsGoldState(NamedTuple):
+    """Cross-round per-participant gradient memory (helper.py:545-549), keyed
+    by participant id instead of the reference's name-keyed dict."""
+    memory: jax.Array  # [num_participants, grad_len] f32
+
+
+def foolsgold_init(num_participants: int, grad_len: int) -> FoolsGoldState:
+    return FoolsGoldState(memory=jnp.zeros((num_participants, grad_len),
+                                           jnp.float32))
+
+
+def foolsgold_weights(feature_grads: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """The FoolsGold re-weighting (helper.py:574-607) on a [C, L] gradient
+    matrix. Returns (wv [C], alpha [C])."""
+    eps = 1e-12
+    norms = jnp.linalg.norm(feature_grads, axis=1)
+    normed = feature_grads / jnp.maximum(norms, eps)[:, None]
+    n = feature_grads.shape[0]
+    cs = normed @ normed.T - jnp.eye(n)
+
+    maxcs = jnp.max(cs, axis=1)
+    # pardoning (helper.py:584-589): cs[i,j] *= maxcs[i]/maxcs[j] when
+    # maxcs[i] < maxcs[j]
+    ratio = maxcs[:, None] / maxcs[None, :]
+    pardon = jnp.where(maxcs[:, None] < maxcs[None, :], ratio, 1.0)
+    pardon = pardon * (1.0 - jnp.eye(n)) + jnp.eye(n)
+    cs = cs * pardon
+
+    row_max = jnp.max(cs, axis=1)
+    wv = 1.0 - row_max
+    wv = jnp.clip(wv, 0.0, 1.0)
+    alpha = row_max
+
+    wv = wv / jnp.max(wv)
+    wv = jnp.where(wv == 1.0, 0.99, wv)
+    logit = jnp.log(wv / (1.0 - wv)) + 0.5
+    # reference: wv[(np.isinf(wv) + wv > 1)] = 1; wv[wv < 0] = 0
+    # (bool-add precedence quirk: (isinf + wv) > 1 — helper.py:603)
+    inf_mask = jnp.isinf(logit).astype(logit.dtype)
+    logit = jnp.where(inf_mask + logit > 1.0, 1.0, logit)
+    logit = jnp.where(logit < 0.0, 0.0, logit)
+    return logit, alpha
+
+
+class FoolsGoldResult(NamedTuple):
+    new_params: Any
+    new_fg_state: FoolsGoldState
+    wv: jax.Array
+    alpha: jax.Array
+
+
+def foolsgold_update(global_params: Any, stacked_grads: Any,
+                     feature_grads: jax.Array, participant_ids: jax.Array,
+                     fg_state: FoolsGoldState, eta: float, lr: float,
+                     momentum: float, weight_decay: float,
+                     use_memory: bool = True) -> FoolsGoldResult:
+    """helper.py:259-293 + FoolsGold.aggregate_gradients (:534-572).
+
+    `stacked_grads`: per-client accumulated gradients over trainable params
+    ([C, ...] leaves, from the client step's grad accumulation —
+    image_train.py:94-100). `feature_grads`: [C, L] flattened gradient of the
+    similarity layer (the reference's `client_grads[i][-2]`). Only trainable
+    params are updated; BN stats are untouched (the reference steps an
+    optimizer over named_parameters only).
+    """
+    memory = fg_state.memory.at[participant_ids].add(feature_grads)
+    current = memory[participant_ids] if use_memory else feature_grads
+    wv, alpha = foolsgold_weights(current)
+
+    num_clients = feature_grads.shape[0]
+
+    def agg(leaf):  # [C, ...] -> [...]
+        w = wv.reshape((num_clients,) + (1,) * (leaf.ndim - 1))
+        return jnp.sum(w * leaf.astype(jnp.float32), axis=0) / num_clients
+
+    agg_grads = jax.tree_util.tree_map(agg, stacked_grads)
+    # Apply via one fresh torch-SGD step with grad = η·agg (helper.py:278-290);
+    # fresh momentum buffers are zero, so momentum is a no-op.
+    scaled = jax.tree_util.tree_map(lambda g: (eta * g), agg_grads)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, global_params)
+    new_params, _ = sgd_step(global_params, scaled, zeros, lr, momentum,
+                             weight_decay)
+    return FoolsGoldResult(new_params, FoolsGoldState(memory), wv, alpha)

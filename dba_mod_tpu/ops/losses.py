@@ -1,0 +1,67 @@
+"""Loss and norm functions used by the client step and evaluation.
+
+Reference semantics preserved:
+- per-batch cross entropy is the MEAN over the batch (torch F.cross_entropy
+  default, image_train.py:85); with padded batches we mean over valid entries;
+- the anomaly-evading blended loss is α·CE + (1-α)·‖w - w_global‖₂
+  (image_train.py:87-90; note: the L2 *norm*, not its square);
+- distance/global norms run over trainable parameters only — torch
+  named_parameters excludes BN running stats but includes BN affine γ/β
+  (helper.py:59-71, :110-123).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  mask: jax.Array | None = None):
+    """Mean cross entropy over valid entries. `logits` may already be
+    log-probabilities (log_softmax is idempotent, matching the reference's
+    MnistNet head — models/MnistNet.py:31)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(nll.dtype)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask) / denom
+
+
+def cross_entropy_sum(logits: jax.Array, labels: jax.Array,
+                      mask: jax.Array | None = None):
+    """Summed cross entropy (reduction='sum'), used by the evaluation battery
+    (test.py:21-22)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    if mask is not None:
+        nll = nll * mask.astype(nll.dtype)
+    return jnp.sum(nll)
+
+
+def tree_dist_norm(params: Any, target_params: Any):
+    """‖w - w_target‖₂ over a params pytree (helper.py:110-123)."""
+    sq = jax.tree_util.tree_reduce(
+        lambda acc, leaves: acc + jnp.sum(jnp.square(leaves)),
+        jax.tree_util.tree_map(lambda a, b: a - b, params, target_params),
+        jnp.float32(0.0))
+    return jnp.sqrt(sq)
+
+
+def tree_global_norm(params: Any):
+    """‖w‖₂ over a params pytree (helper.py:59-64)."""
+    sq = jax.tree_util.tree_reduce(
+        lambda acc, leaf: acc + jnp.sum(jnp.square(leaf)), params,
+        jnp.float32(0.0))
+    return jnp.sqrt(sq)
+
+
+def blended_poison_loss(class_loss, dist_norm, alpha: float):
+    """α·CE + (1-α)·distance (image_train.py:89-90). With the configs' α=1 the
+    distance term vanishes but stays differentiable for α<1 runs."""
+    return alpha * class_loss + (1.0 - alpha) * dist_norm

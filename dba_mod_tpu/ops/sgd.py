@@ -1,0 +1,104 @@
+"""SGD with torch semantics, as pure pytree functions.
+
+The reference trains every client with ``torch.optim.SGD(lr, momentum,
+weight_decay)`` created fresh each round (reference image_train.py:33-35,
+loan_train.py:29-31, poison variants image_train.py:63-65), so momentum buffers
+always start at zero within a round. torch's update rule (dampening=0,
+nesterov=False) is::
+
+    g   = grad + weight_decay * param        # coupled decay
+    buf = momentum * buf + g
+    param -= lr * buf
+
+which differs from optax.sgd's decoupled-decay conventions, so we implement it
+directly; `lr` may be a traced scalar, enabling per-client learning rates under
+vmap.
+
+Also here: the poison MultiStepLR schedule (reference image_train.py:66-68)
+including torch's float-milestone quirk, and the LOAN adaptive poison-LR rule
+(reference loan_train.py:71-75) as an in-graph function of backdoor accuracy.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sgd_init(params: Any) -> Any:
+    """Zero momentum buffers shaped like `params`."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def sgd_step(params: Any, grads: Any, momentum_buf: Any, lr,
+             momentum: float, weight_decay: float):
+    """One torch-SGD step. Returns (new_params, new_momentum_buf)."""
+
+    def upd(p, g, b):
+        g = g + weight_decay * p
+        b = momentum * b + g
+        return p - lr * b, b
+
+    flat = jax.tree_util.tree_map(upd, params, grads, momentum_buf)
+    new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                        is_leaf=lambda t: isinstance(t, tuple))
+    new_buf = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                     is_leaf=lambda t: isinstance(t, tuple))
+    return new_params, new_buf
+
+
+def _milestone_hits(milestones: Sequence[float]) -> list[int]:
+    """torch MultiStepLR stores milestones in a Counter keyed by the raw float;
+    an integer epoch only matches a float milestone when the float is exactly
+    integral (hash equality: 2 == 2.0). E.g. internal_poison_epochs=6 gives
+    milestones [1.2000000000000002, 4.800000000000001] which NEVER fire, while
+    E=10 gives [2.0, 8.0] which do — reference image_train.py:66-68 inherits
+    this quirk and we reproduce it."""
+    hits = []
+    for m in milestones:
+        if float(m) == int(m):
+            hits.append(int(m))
+    return hits
+
+
+def multistep_lr_array(num_epochs: int, milestones: Sequence[float],
+                       gamma: float = 0.1, step_before: bool = False) -> np.ndarray:
+    """Per-internal-epoch LR *multipliers* (relative to base lr), length
+    `num_epochs`, for 1-based internal epochs.
+
+    step_before=False (image, reference image_train.py:118-119): scheduler.step()
+    runs at the END of each internal epoch, so epoch i uses
+    gamma^|{m <= i-1}|.
+    step_before=True (LOAN, reference loan_train.py:90-92): scheduler.step()
+    runs at the TOP of each internal epoch, so epoch i uses gamma^|{m <= i}|.
+    """
+    hits = _milestone_hits(milestones)
+    out = np.empty((max(num_epochs, 1),), np.float32)
+    for i in range(1, max(num_epochs, 1) + 1):
+        bound = i if step_before else i - 1
+        k = sum(1 for m in hits if m <= bound)
+        out[i - 1] = gamma ** k
+    return out
+
+
+def poison_multistep_lr_array(internal_poison_epochs: int, gamma: float = 0.1,
+                              step_before: bool = False) -> np.ndarray:
+    """The reference's poison schedule: milestones at {0.2, 0.8}·E
+    (image_train.py:66-68, loan_train.py:83-85)."""
+    e = internal_poison_epochs
+    return multistep_lr_array(e, [0.2 * e, 0.8 * e], gamma, step_before)
+
+
+def loan_adaptive_poison_lr(base_poison_lr, backdoor_acc, baseline: bool):
+    """LOAN poison-LR decay by current backdoor accuracy (loan_train.py:71-75):
+    acc>20 → lr/5, additionally acc>60 → lr/10 (cumulative /50). `backdoor_acc`
+    is a traced percentage scalar; returns a traced lr."""
+    if baseline:
+        return jnp.asarray(base_poison_lr, jnp.float32)
+    lr = jnp.asarray(base_poison_lr, jnp.float32)
+    lr = jnp.where(backdoor_acc > 20.0, lr / 5.0, lr)
+    lr = jnp.where(backdoor_acc > 60.0, lr / 10.0, lr)
+    return lr

@@ -1,0 +1,128 @@
+"""Backdoor trigger machinery as pure, vmap-safe jnp ops.
+
+The reference stamps pixel patterns per-sample in a Python loop
+(image_helper.py:298-350) and assigns LOAN feature columns per-sample
+(loan_train.py:99-107, test.py:75-81). TPU-native equivalents:
+
+- a *pattern bank*: [trigger_num + 1, H, W] {0,1} masks built once on host,
+  where row `i` is adversary i's sub-pattern and the LAST row is the combined
+  (global) pattern used by `adversarial_index == -1` (image_helper.py:331-335);
+  stamping is then `img·(1-mask) + mask` broadcast over channels — pixels are
+  set to 1.0 in every channel (image_helper.py:336-348);
+- a *feature-trigger bank* for LOAN: [trigger_num + 1, F] value rows plus
+  {0,1} masks over feature columns; stamping is a vectorized select;
+- batch poisoning as a per-sample boolean: training poisons the first
+  `poisoning_per_batch` samples of each batch, evaluation poisons all
+  (image_helper.py:306-319).
+
+All functions take the bank + a traced `adv_index` so one jitted computation
+serves every adversary; index -1 (mapped to the last bank row) is the global
+pattern.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+
+
+# --------------------------------------------------------------------- builders
+def build_pixel_pattern_bank(params: cfg.Params, height: int,
+                             width: int) -> np.ndarray:
+    """[trigger_num + 1, H, W] float32 {0,1} masks; row trigger_num is the
+    union of all sub-patterns (the global/combined trigger)."""
+    n = int(params["trigger_num"])
+    bank = np.zeros((n + 1, height, width), np.float32)
+    for i in range(n):
+        for (r, c) in params.poison_pattern_for(i):
+            bank[i, r, c] = 1.0
+            bank[n, r, c] = 1.0
+    return bank
+
+
+def build_feature_trigger_bank(params: cfg.Params,
+                               feature_dict: dict,
+                               num_features: int
+                               ) -> Tuple[np.ndarray, np.ndarray]:
+    """LOAN: ([trigger_num + 1, F] values, [trigger_num + 1, F] {0,1} masks);
+    row trigger_num is all per-adversary triggers concatenated
+    (loan_train.py:49-57). Later values win on overlap, matching the
+    reference's sequential assignment."""
+    n = int(params["trigger_num"])
+    values = np.zeros((n + 1, num_features), np.float32)
+    masks = np.zeros((n + 1, num_features), np.float32)
+    for i in range(n):
+        names, vals = params.poison_trigger_features_for(i)
+        for name, val in zip(names, vals):
+            col = feature_dict[name]
+            values[i, col] = val
+            masks[i, col] = 1.0
+            values[n, col] = val
+            masks[n, col] = 1.0
+    return values, masks
+
+
+def bank_row(adv_index, bank_size: int):
+    """Map a (possibly traced) adversarial index to a bank row: -1 → last row
+    (the combined/global pattern)."""
+    return jnp.where(adv_index < 0, bank_size - 1, adv_index)
+
+
+# --------------------------------------------------------------------- stamping
+def stamp_pixel_pattern(images: jax.Array, pattern_bank: jax.Array,
+                        adv_index) -> jax.Array:
+    """Stamp trigger pixels to 1.0 in all channels. images: [..., H, W, C]
+    (NHWC); pattern_bank: [K, H, W]; adv_index: traced scalar, -1 = global."""
+    mask = pattern_bank[bank_row(adv_index, pattern_bank.shape[0])]
+    mask = mask[..., None]  # broadcast over channels
+    return images * (1.0 - mask) + mask
+
+
+def stamp_feature_trigger(rows: jax.Array, value_bank: jax.Array,
+                          mask_bank: jax.Array, adv_index) -> jax.Array:
+    """LOAN: assign trigger feature values. rows: [..., F]."""
+    k = bank_row(adv_index, value_bank.shape[0])
+    values, mask = value_bank[k], mask_bank[k]
+    return rows * (1.0 - mask) + values * mask
+
+
+def poison_batch(images: jax.Array, labels: jax.Array,
+                 pattern_bank: jax.Array, adv_index,
+                 poison_label_swap: int, poisoning_per_batch,
+                 poison_all=False):
+    """Poison a batch the reference way (image_helper.py:298-326): the first
+    `poisoning_per_batch` samples (all if `poison_all`, the evaluation mode)
+    get the trigger stamped and their label set to `poison_label_swap`.
+
+    Returns (new_images, new_labels, per_sample_poisoned_mask). All selector
+    args may be traced, so benign clients ride the same jitted computation with
+    `poisoning_per_batch=0`.
+    """
+    batch = images.shape[0]
+    idx = jnp.arange(batch)
+    sel = jnp.where(poison_all, jnp.ones((batch,), bool),
+                    idx < poisoning_per_batch)
+    stamped = stamp_pixel_pattern(images, pattern_bank, adv_index)
+    sel_img = sel.reshape((batch,) + (1,) * (images.ndim - 1))
+    new_images = jnp.where(sel_img, stamped, images)
+    new_labels = jnp.where(sel, poison_label_swap, labels)
+    return new_images, new_labels, sel
+
+
+def poison_batch_features(rows: jax.Array, labels: jax.Array,
+                          value_bank: jax.Array, mask_bank: jax.Array,
+                          adv_index, poison_label_swap: int,
+                          poisoning_per_batch, poison_all=False):
+    """LOAN counterpart of :func:`poison_batch` (loan_train.py:99-107)."""
+    batch = rows.shape[0]
+    idx = jnp.arange(batch)
+    sel = jnp.where(poison_all, jnp.ones((batch,), bool),
+                    idx < poisoning_per_batch)
+    stamped = stamp_feature_trigger(rows, value_bank, mask_bank, adv_index)
+    new_rows = jnp.where(sel[:, None], stamped, rows)
+    new_labels = jnp.where(sel, poison_label_swap, labels)
+    return new_rows, new_labels, sel

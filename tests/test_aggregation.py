@@ -1,0 +1,197 @@
+"""Golden tests for the three aggregation rules against independent numpy
+oracles implementing the reference semantics (helper.py:240-418, :527-607)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.ops import aggregation as agg
+
+
+def _rand_tree(rng, batch=None):
+    shape = lambda *s: (batch,) + s if batch else s
+    return {"dense": {"kernel": rng.randn(*shape(4, 3)).astype(np.float32),
+                      "bias": rng.randn(*shape(3)).astype(np.float32)},
+            "bn": {"mean": rng.randn(*shape(3)).astype(np.float32)}}
+
+
+def _flat(tree_leaf_list):
+    return np.concatenate([l.reshape(-1) for l in tree_leaf_list])
+
+
+# ------------------------------------------------------------------- FedAvg
+def test_fedavg_matches_manual():
+    rng = np.random.RandomState(0)
+    g = _rand_tree(rng)
+    deltas = _rand_tree(rng, batch=5)
+    eta, no_models = 0.1, 5
+    new = agg.fedavg_update(g, jax.tree_util.tree_map(jnp.asarray, deltas),
+                            eta, no_models)
+    for path in [("dense", "kernel"), ("dense", "bias"), ("bn", "mean")]:
+        got = np.asarray(new[path[0]][path[1]])
+        exp = g[path[0]][path[1]] + eta / no_models * deltas[path[0]][path[1]].sum(0)
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------------- RFA
+def _numpy_weiszfeld(points, num_samples, maxiter=10, eps=1e-5, ftol=1e-6):
+    """Independent oracle for helper.py:295-353: weighted-average start, then
+    weights α_i / max(eps, ‖median − p_i‖), normalized; break on ftol."""
+    alphas = np.asarray(num_samples, np.float64)
+    alphas = alphas / alphas.sum()
+    median = (alphas[:, None] * points).sum(0)
+    obj = (alphas * np.linalg.norm(points - median, axis=1)).sum()
+    calls, wv = 1, alphas.copy()
+    for _ in range(maxiter):
+        dist = np.linalg.norm(points - median, axis=1)
+        w = alphas / np.maximum(eps, dist)
+        w = w / w.sum()
+        new_median = (w[:, None] * points).sum(0)
+        new_obj = (alphas * np.linalg.norm(points - new_median, axis=1)).sum()
+        calls += 1
+        median, prev_obj, obj = new_median, obj, new_obj
+        wv = w
+        if abs(prev_obj - obj) < ftol * obj:
+            break
+    return median, wv, calls
+
+
+def test_rfa_matches_numpy_oracle():
+    rng = np.random.RandomState(1)
+    g = _rand_tree(rng)
+    deltas = _rand_tree(rng, batch=6)
+    num_samples = np.array([100, 50, 80, 120, 60, 90], np.float32)
+
+    res = agg.geometric_median_update(
+        g, jax.tree_util.tree_map(jnp.asarray, deltas),
+        jnp.asarray(num_samples), eta=0.1, maxiter=10)
+
+    # leaf order: jax flattens dict keys alphabetically (bn < dense), and
+    # within dense: bias < kernel
+    points = np.stack([_flat([deltas["bn"]["mean"][i],
+                              deltas["dense"]["bias"][i],
+                              deltas["dense"]["kernel"][i]])
+                       for i in range(6)])
+    exp_median, exp_wv, exp_calls = _numpy_weiszfeld(points, num_samples)
+
+    np.testing.assert_allclose(np.asarray(res.wv), exp_wv, rtol=1e-4)
+    assert int(res.num_oracle_calls) == exp_calls
+    assert bool(res.is_updated)
+    got_state = _flat([np.asarray(res.new_state["bn"]["mean"]),
+                       np.asarray(res.new_state["dense"]["bias"]),
+                       np.asarray(res.new_state["dense"]["kernel"])])
+    exp_state = _flat([g["bn"]["mean"], g["dense"]["bias"],
+                       g["dense"]["kernel"]]) + 0.1 * exp_median
+    np.testing.assert_allclose(got_state, exp_state, rtol=1e-4, atol=1e-5)
+
+
+def test_rfa_identical_points_converges_immediately_no_crash():
+    """Reference crashes at helper.py:371 when Weiszfeld converges at iter 0
+    (wv=None); our fix reports the latest weights instead."""
+    rng = np.random.RandomState(2)
+    one = _rand_tree(rng)
+    deltas = jax.tree_util.tree_map(
+        lambda l: jnp.broadcast_to(jnp.asarray(l), (4,) + l.shape), one)
+    res = agg.geometric_median_update(
+        one, deltas, jnp.asarray(np.full(4, 10.0, np.float32)), eta=1.0)
+    assert np.all(np.isfinite(np.asarray(res.wv)))
+    assert int(res.num_oracle_calls) >= 1
+
+
+# ------------------------------------------------------------------- FoolsGold
+def _numpy_foolsgold(grads):
+    """Independent oracle for FoolsGold.foolsgold (helper.py:574-607)."""
+    import sklearn.metrics.pairwise as smp
+    n = grads.shape[0]
+    cs = smp.cosine_similarity(grads) - np.eye(n)
+    maxcs = np.max(cs, axis=1)
+    for i in range(n):
+        for j in range(n):
+            if i != j and maxcs[i] < maxcs[j]:
+                cs[i][j] = cs[i][j] * maxcs[i] / maxcs[j]
+    wv = 1 - (np.max(cs, axis=1))
+    wv[wv > 1] = 1
+    wv[wv < 0] = 0
+    alpha = np.max(cs, axis=1)
+    wv = wv / np.max(wv)
+    wv[(wv == 1)] = .99
+    wv = (np.log(wv / (1 - wv)) + 0.5)
+    wv[(np.isinf(wv) + wv > 1)] = 1
+    wv[(wv < 0)] = 0
+    return wv, alpha
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_foolsgold_weights_match_numpy(seed):
+    rng = np.random.RandomState(seed)
+    grads = rng.randn(8, 30).astype(np.float32)
+    # two sybils with near-identical gradient directions
+    grads[6] = grads[7] + 0.01 * rng.randn(30).astype(np.float32)
+    exp_wv, exp_alpha = _numpy_foolsgold(grads.astype(np.float64))
+    got_wv, got_alpha = agg.foolsgold_weights(jnp.asarray(grads))
+    np.testing.assert_allclose(np.asarray(got_wv), exp_wv, rtol=1e-3, atol=1e-3)
+    # alpha is visualization-only in the reference; f32-vs-f64 cosine matrices
+    # amplified through the pardoning ratios justify a looser tolerance.
+    np.testing.assert_allclose(np.asarray(got_alpha), exp_alpha, rtol=1e-2,
+                               atol=5e-3)
+
+
+def test_foolsgold_sybils_downweighted():
+    rng = np.random.RandomState(3)
+    grads = rng.randn(6, 50).astype(np.float32)
+    grads[4] = grads[5]  # perfect sybils
+    wv, _ = agg.foolsgold_weights(jnp.asarray(grads))
+    wv = np.asarray(wv)
+    assert wv[4] < 0.01 and wv[5] < 0.01
+    assert wv[:4].min() > 0.5
+
+
+def test_foolsgold_update_applies_sgd_and_memory():
+    rng = np.random.RandomState(4)
+    params = {"w": jnp.asarray(rng.randn(5, 4).astype(np.float32)),
+              "head": jnp.asarray(rng.randn(4, 3).astype(np.float32))}
+    C, L = 4, 12
+    grads = {"w": jnp.asarray(rng.randn(C, 5, 4).astype(np.float32)),
+             "head": jnp.asarray(rng.randn(C, 4, 3).astype(np.float32))}
+    feature = jnp.reshape(grads["head"], (C, L))
+    ids = jnp.asarray([0, 3, 7, 9])
+    st = agg.foolsgold_init(10, L)
+
+    res = agg.foolsgold_update(params, grads, feature, ids, st, eta=0.1,
+                               lr=0.1, momentum=0.9, weight_decay=0.0005)
+    # memory accumulated at participant rows
+    mem = np.asarray(res.new_fg_state.memory)
+    np.testing.assert_allclose(mem[3], np.asarray(feature)[1], rtol=1e-6)
+    assert (mem[1] == 0).all()
+
+    # aggregation + torch-SGD apply: p' = p - lr*(eta*sum(wv*g)/C + wd*p)
+    wv = np.asarray(res.wv)
+    agg_w = (wv[:, None, None] * np.asarray(grads["w"])).sum(0) / C
+    exp_w = np.asarray(params["w"]) - 0.1 * (
+        0.1 * agg_w + 0.0005 * np.asarray(params["w"]))
+    np.testing.assert_allclose(np.asarray(res.new_params["w"]), exp_w,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_foolsgold_memory_across_rounds():
+    """use_memory=True computes similarity on the historical sum
+    (helper.py:545-553): sybils that alternate directions each round are still
+    caught by the memory."""
+    rng = np.random.RandomState(5)
+    L = 20
+    st = agg.foolsgold_init(4, L)
+    base = rng.randn(L).astype(np.float32)
+    ids = jnp.arange(4)
+    for sign in (1.0, 1.0):
+        feature = np.stack([rng.randn(L), rng.randn(L),
+                            sign * base, sign * base]).astype(np.float32)
+        params = {"w": jnp.zeros((2, 2))}
+        grads = {"w": jnp.zeros((4, 2, 2))}
+        res = agg.foolsgold_update(params, grads, jnp.asarray(feature), ids,
+                                   st, eta=0.1, lr=0.1, momentum=0.0,
+                                   weight_decay=0.0)
+        st = res.new_fg_state
+    wv = np.asarray(res.wv)
+    assert wv[2] < 0.01 and wv[3] < 0.01
+    assert wv[0] > 0.5 and wv[1] > 0.5

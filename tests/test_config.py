@@ -66,12 +66,17 @@ def test_pattern_union():
     assert len(combined) == 8 and [0, 9] in combined and [4, 10] in combined
 
 
-def test_poison_epochs_fallback_to_global():
+def test_poison_epochs_missing_slot_key_raises():
+    # Reference parity: image_train.py:43 / main.py:151 look the per-slot key
+    # up unconditionally — a missing key must fail loudly, not silently
+    # schedule the global default.
     raw = dict(BASE)
     del raw["2_poison_epochs"]
     p = cfg.Params.from_dict(raw)
-    assert p.poison_epochs_for(2) == [1]
+    with pytest.raises(KeyError):
+        p.poison_epochs_for(2)
     assert p.poison_epochs_for(0) == [3]
+    assert p.poison_epochs_for(-1) == [1]  # benign default
 
 
 def test_scheduled_adversaries():

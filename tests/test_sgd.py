@@ -1,0 +1,120 @@
+"""Golden tests: torch-semantics SGD and LR schedules vs real torch (CPU).
+
+The client step's optimizer must match torch.optim.SGD(lr, momentum,
+weight_decay) and torch MultiStepLR including its float-milestone quirk
+(reference image_train.py:33-35, :66-68) — torch itself is the oracle here.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.ops import sgd as sgd_ops
+
+
+def _torch_sgd_trajectory(params0, grads_seq, lr, momentum, wd):
+    import torch
+    ps = [torch.nn.Parameter(torch.tensor(p)) for p in params0]
+    opt = torch.optim.SGD(ps, lr=lr, momentum=momentum, weight_decay=wd)
+    for grads in grads_seq:
+        opt.zero_grad()
+        for p, g in zip(ps, grads):
+            p.grad = torch.tensor(g)
+        opt.step()
+    return [p.detach().numpy() for p in ps]
+
+
+def test_sgd_matches_torch_multi_step():
+    rng = np.random.RandomState(0)
+    params0 = [rng.randn(4, 3).astype(np.float32),
+               rng.randn(5).astype(np.float32)]
+    grads_seq = [[rng.randn(4, 3).astype(np.float32),
+                  rng.randn(5).astype(np.float32)] for _ in range(5)]
+
+    expected = _torch_sgd_trajectory(params0, grads_seq, lr=0.1, momentum=0.9,
+                                     wd=0.0005)
+
+    params = [jnp.asarray(p) for p in params0]
+    buf = sgd_ops.sgd_init(params)
+    for grads in grads_seq:
+        params, buf = sgd_ops.sgd_step(params, [jnp.asarray(g) for g in grads],
+                                       buf, 0.1, 0.9, 0.0005)
+    for got, exp in zip(params, expected):
+        np.testing.assert_allclose(np.asarray(got), exp, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("E,step_before", [(10, False), (6, False), (5, False),
+                                           (10, True), (6, True)])
+def test_multistep_lr_matches_torch(E, step_before):
+    import torch
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=1.0)
+    sched = torch.optim.lr_scheduler.MultiStepLR(
+        opt, milestones=[0.2 * E, 0.8 * E], gamma=0.1)
+    torch_lrs = []
+    for _ in range(1, E + 1):
+        if step_before:
+            sched.step()
+        torch_lrs.append(opt.param_groups[0]["lr"])
+        if not step_before:
+            opt.step()
+            sched.step()
+    ours = sgd_ops.poison_multistep_lr_array(E, 0.1, step_before=step_before)
+    np.testing.assert_allclose(ours, np.array(torch_lrs, np.float32), rtol=1e-6)
+
+
+def test_float_milestones_never_fire_for_E6():
+    # 0.2*6 = 1.2000000000000002 — torch never decays; we must not either.
+    ours = sgd_ops.poison_multistep_lr_array(6, 0.1, step_before=False)
+    np.testing.assert_array_equal(ours, np.ones(6, np.float32))
+
+
+def test_loan_adaptive_poison_lr():
+    lr = sgd_ops.loan_adaptive_poison_lr(0.0005, jnp.float32(10.0), False)
+    assert np.isclose(float(lr), 0.0005)
+    lr = sgd_ops.loan_adaptive_poison_lr(0.0005, jnp.float32(30.0), False)
+    assert np.isclose(float(lr), 0.0001)
+    lr = sgd_ops.loan_adaptive_poison_lr(0.0005, jnp.float32(70.0), False)
+    assert np.isclose(float(lr), 1e-5)
+    # baseline flag disables adaptation (loan_train.py:71)
+    lr = sgd_ops.loan_adaptive_poison_lr(0.0005, jnp.float32(70.0), True)
+    assert np.isclose(float(lr), 0.0005)
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    from dba_mod_tpu.ops import losses
+
+    rng = np.random.RandomState(1)
+    logits = rng.randn(8, 10).astype(np.float32)
+    labels = rng.randint(0, 10, size=(8,))
+    exp_mean = float(F.cross_entropy(torch.tensor(logits),
+                                     torch.tensor(labels)))
+    exp_sum = float(F.cross_entropy(torch.tensor(logits),
+                                    torch.tensor(labels), reduction="sum"))
+    got_mean = float(losses.cross_entropy(jnp.asarray(logits),
+                                          jnp.asarray(labels)))
+    got_sum = float(losses.cross_entropy_sum(jnp.asarray(logits),
+                                             jnp.asarray(labels)))
+    assert np.isclose(got_mean, exp_mean, rtol=1e-5)
+    assert np.isclose(got_sum, exp_sum, rtol=1e-5)
+
+    # masked mean == torch mean over the valid prefix
+    mask = np.array([1, 1, 1, 1, 1, 0, 0, 0], np.float32)
+    exp_masked = float(F.cross_entropy(torch.tensor(logits[:5]),
+                                       torch.tensor(labels[:5])))
+    got_masked = float(losses.cross_entropy(jnp.asarray(logits),
+                                            jnp.asarray(labels),
+                                            jnp.asarray(mask)))
+    assert np.isclose(got_masked, exp_masked, rtol=1e-5)
+
+
+def test_dist_norm_matches_reference_semantics():
+    from dba_mod_tpu.ops import losses
+    a = {"w": jnp.ones((3, 3)), "b": jnp.full((3,), 2.0)}
+    b = {"w": jnp.zeros((3, 3)), "b": jnp.zeros((3,))}
+    # sqrt(9*1 + 3*4) = sqrt(21)
+    assert np.isclose(float(losses.tree_dist_norm(a, b)), np.sqrt(21.0))
+    assert np.isclose(float(losses.tree_global_norm(a)), np.sqrt(21.0))

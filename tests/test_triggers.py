@@ -1,0 +1,106 @@
+"""Trigger stamping parity with reference image_helper.py:298-350 and
+loan_train.py:99-107 / test.py:75-81 semantics."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.ops import triggers
+
+CIFAR_PATTERNS = {
+    "0_poison_pattern": [[0, 0], [0, 1], [0, 2], [0, 3], [0, 4], [0, 5]],
+    "1_poison_pattern": [[0, 9], [0, 10], [0, 11], [0, 12], [0, 13], [0, 14]],
+    "2_poison_pattern": [[4, 0], [4, 1], [4, 2], [4, 3], [4, 4], [4, 5]],
+    "3_poison_pattern": [[4, 9], [4, 10], [4, 11], [4, 12], [4, 13], [4, 14]],
+}
+
+
+def _params(**extra):
+    d = dict(type="cifar", lr=0.1, batch_size=64, epochs=10, no_models=10,
+             number_of_total_participants=100, eta=0.1,
+             aggregation_methods="mean", trigger_num=4, poison_label_swap=2,
+             poisoning_per_batch=5, **CIFAR_PATTERNS)
+    d.update(extra)
+    return cfg.Params.from_dict(d)
+
+
+def test_pattern_bank_rows_and_union():
+    bank = triggers.build_pixel_pattern_bank(_params(), 32, 32)
+    assert bank.shape == (5, 32, 32)
+    for i in range(4):
+        assert bank[i].sum() == 6
+        for (r, c) in CIFAR_PATTERNS[f"{i}_poison_pattern"]:
+            assert bank[i, r, c] == 1.0
+    # last row = union of all sub-patterns (adversarial_index == -1)
+    assert bank[4].sum() == 24
+    np.testing.assert_array_equal(bank[4], np.clip(bank[:4].sum(0), 0, 1))
+
+
+def test_stamp_sets_all_channels_to_one():
+    bank = jnp.asarray(triggers.build_pixel_pattern_bank(_params(), 32, 32))
+    img = jnp.full((2, 32, 32, 3), 0.25)
+    out = np.asarray(triggers.stamp_pixel_pattern(img, bank, jnp.int32(2)))
+    for (r, c) in CIFAR_PATTERNS["2_poison_pattern"]:
+        np.testing.assert_array_equal(out[:, r, c, :], 1.0)
+    # untouched elsewhere
+    assert np.isclose(out[0, 10, 10, 0], 0.25)
+    # adv_index -1 = combined pattern
+    out = np.asarray(triggers.stamp_pixel_pattern(img, bank, jnp.int32(-1)))
+    for i in range(4):
+        for (r, c) in CIFAR_PATTERNS[f"{i}_poison_pattern"]:
+            np.testing.assert_array_equal(out[:, r, c, :], 1.0)
+
+
+def test_poison_batch_first_k_training_all_eval():
+    p = _params()
+    bank = jnp.asarray(triggers.build_pixel_pattern_bank(p, 32, 32))
+    imgs = jnp.zeros((8, 32, 32, 3))
+    labels = jnp.arange(8)
+    out_i, out_l, sel = triggers.poison_batch(
+        imgs, labels, bank, jnp.int32(0), 2, jnp.int32(5), poison_all=False)
+    assert np.asarray(sel).sum() == 5
+    np.testing.assert_array_equal(np.asarray(out_l)[:5], 2)
+    np.testing.assert_array_equal(np.asarray(out_l)[5:], [5, 6, 7])
+    assert np.asarray(out_i)[0, 0, 0, 0] == 1.0   # stamped
+    assert np.asarray(out_i)[7, 0, 0, 0] == 0.0   # clean
+
+    _, out_l, sel = triggers.poison_batch(
+        imgs, labels, bank, jnp.int32(0), 2, jnp.int32(5), poison_all=True)
+    assert np.asarray(sel).all()
+    np.testing.assert_array_equal(np.asarray(out_l), 2)
+
+    # benign lane: poisoning_per_batch=0 leaves the batch untouched
+    out_i, out_l, sel = triggers.poison_batch(
+        imgs, labels, bank, jnp.int32(0), 2, jnp.int32(0), poison_all=False)
+    assert not np.asarray(sel).any()
+    np.testing.assert_array_equal(np.asarray(out_l), np.arange(8))
+    assert np.asarray(out_i).sum() == 0.0
+
+
+def test_loan_feature_triggers():
+    p = cfg.Params.from_dict(dict(
+        type="loan", lr=0.001, batch_size=64, epochs=10, no_models=10,
+        number_of_total_participants=50, eta=0.1, aggregation_methods="mean",
+        trigger_num=2, poison_label_swap=7,
+        **{"0_poison_trigger_names": ["f_a", "f_b"],
+           "0_poison_trigger_values": [10, 80],
+           "1_poison_trigger_names": ["f_c"],
+           "1_poison_trigger_values": [20]}))
+    feature_dict = {"f_a": 0, "f_b": 3, "f_c": 5}
+    values, masks = triggers.build_feature_trigger_bank(p, feature_dict, 8)
+    assert values.shape == (3, 8)
+    assert values[0, 0] == 10 and values[0, 3] == 80 and masks[0, 5] == 0
+    assert values[1, 5] == 20 and masks[1, 0] == 0
+    # combined row
+    assert values[2, 0] == 10 and values[2, 3] == 80 and values[2, 5] == 20
+
+    rows = jnp.full((4, 8), -1.0)
+    labels = jnp.zeros((4,), jnp.int32)
+    out_r, out_l, sel = triggers.poison_batch_features(
+        rows, labels, jnp.asarray(values), jnp.asarray(masks), jnp.int32(-1),
+        7, jnp.int32(2), poison_all=False)
+    out_r = np.asarray(out_r)
+    assert out_r[0, 0] == 10 and out_r[0, 3] == 80 and out_r[0, 5] == 20
+    assert out_r[0, 1] == -1.0            # non-trigger features untouched
+    assert (out_r[2] == -1.0).all()       # beyond poisoning_per_batch
+    np.testing.assert_array_equal(np.asarray(out_l), [7, 7, 0, 0])
