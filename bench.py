@@ -1,0 +1,83 @@
+"""Benchmark: CIFAR-10 FL rounds/sec (100 clients, 10/round, narrow
+ResNet-18) on the available accelerator — the north-star workload
+(BASELINE.json: CIFAR-10 DBA on v5e; its steady-state rounds are clean, since
+single-shot poisoning touches 4 of 300 rounds).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is measured against a reference-style sequential torch loop doing
+identical work on this host's CPU (benchmarks/torch_reference.py) — the only
+runnable form of the reference in this zero-egress, GPU-less image; the
+reference repo publishes no numbers of its own (BASELINE.md). The baseline
+measurement is cached in BENCH_BASELINE_LOCAL.json after the first run.
+
+Usage: python bench.py [--rounds N] [--skip-baseline]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).parent
+CACHE = REPO / "BENCH_BASELINE_LOCAL.json"
+
+BENCH_CONFIG = dict(
+    type="cifar", lr=0.1, batch_size=64, epochs=10, no_models=10,
+    number_of_total_participants=100, eta=0.1, aggregation_methods="mean",
+    internal_epochs=2, momentum=0.9, decay=0.0005, is_poison=False,
+    synthetic_data=True,  # zero-egress image: CIFAR-shaped synthetic data
+    sampling_dirichlet=True, dirichlet_alpha=0.5, local_eval=True,
+    random_seed=1)
+
+
+def measure_ours(timed_rounds: int) -> float:
+    from dba_mod_tpu.config import Params
+    from dba_mod_tpu.fl.experiment import Experiment
+
+    exp = Experiment(Params.from_dict(BENCH_CONFIG), save_results=False)
+    exp.run_round(1)  # warmup: compiles round + eval programs
+    t0 = time.time()
+    for i in range(2, 2 + timed_rounds):
+        exp.run_round(i)
+    return (time.time() - t0) / timed_rounds
+
+
+def baseline_seconds_per_round(skip: bool) -> float | None:
+    if CACHE.exists():
+        return json.loads(CACHE.read_text())["seconds_per_round"]
+    if skip:
+        return None
+    from benchmarks.torch_reference import measure_torch_reference_round
+    secs = measure_torch_reference_round(
+        num_clients=BENCH_CONFIG["no_models"], samples_per_client=500,
+        batch_size=BENCH_CONFIG["batch_size"],
+        internal_epochs=BENCH_CONFIG["internal_epochs"])
+    CACHE.write_text(json.dumps({
+        "seconds_per_round": secs,
+        "what": "reference-style sequential torch loop, same work, this "
+                "host's CPU (see benchmarks/torch_reference.py)"}, indent=1))
+    return secs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--skip-baseline", action="store_true")
+    args = ap.parse_args()
+
+    ours = measure_ours(args.rounds)
+    base = baseline_seconds_per_round(args.skip_baseline)
+    rounds_per_sec = 1.0 / ours
+    vs = (base / ours) if base else 1.0
+    print(json.dumps({"metric": "cifar10_fl_rounds_per_sec",
+                      "value": round(rounds_per_sec, 4),
+                      "unit": "rounds/sec",
+                      "vs_baseline": round(vs, 2)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""A torch implementation of one reference-style FL round, used ONLY as the
+bench baseline denominator.
+
+This is a fresh implementation of the reference's *workload semantics*
+(sequential per-client SGD on one shared model + FedAvg + per-client and
+global eval — image_train.py:21-271, helper.py:240-257, main.py:198-201), not
+a copy of its code. It exists because the reference itself cannot run here
+(zero egress: no dataset downloads, no visdom; no GPU), so the recorded
+baseline is this loop on the same host's CPU via stock torch — the only
+reference-framework measurement available in this environment. BASELINE.md
+records that the reference publishes no numbers of its own.
+"""
+from __future__ import annotations
+
+import time
+from typing import List
+
+import numpy as np
+
+
+def _narrow_resnet18(num_classes: int = 10):
+    """torch equivalent of the narrow (32/64/128/256) CIFAR ResNet-18 the
+    reference trains (models/resnet_cifar.py:70-116 widths)."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class Block(nn.Module):
+        def __init__(self, in_p, p, stride):
+            super().__init__()
+            self.c1 = nn.Conv2d(in_p, p, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(p)
+            self.c2 = nn.Conv2d(p, p, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(p)
+            self.short = None
+            if stride != 1 or in_p != p:
+                self.short = nn.Sequential(
+                    nn.Conv2d(in_p, p, 1, stride, bias=False),
+                    nn.BatchNorm2d(p))
+
+        def forward(self, x):
+            y = F.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            s = x if self.short is None else self.short(x)
+            return F.relu(y + s)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            widths = [32, 64, 128, 256]
+            self.stem = nn.Sequential(nn.Conv2d(3, 32, 3, 1, 1, bias=False),
+                                      nn.BatchNorm2d(32), nn.ReLU())
+            layers: List[nn.Module] = []
+            in_p = 32
+            for stage, p in enumerate(widths):
+                for i in range(2):
+                    stride = 2 if (stage > 0 and i == 0) else 1
+                    layers.append(Block(in_p, p, stride))
+                    in_p = p
+            self.body = nn.Sequential(*layers)
+            self.head = nn.Linear(256, num_classes)
+
+        def forward(self, x):
+            x = self.body(self.stem(x))
+            x = F.avg_pool2d(x, 4).flatten(1)
+            return self.head(x)
+
+    return Net()
+
+
+def measure_torch_reference_round(num_clients: int = 10,
+                                  samples_per_client: int = 500,
+                                  batch_size: int = 64,
+                                  internal_epochs: int = 2,
+                                  test_size: int = 10000,
+                                  lr: float = 0.1, eta: float = 0.1,
+                                  threads: int | None = None,
+                                  sample_clients: int | None = None) -> float:
+    """Wall-clock seconds for ONE reference-style clean FL round: sequential
+    clients (shared local model re-seeded from the global state_dict each
+    time), per-client SGD epochs, per-client full-test-set eval, FedAvg,
+    global eval — the same work our round does in one XLA computation.
+
+    `sample_clients`: measure only that many clients and extrapolate linearly
+    to `num_clients` (the loop is embarrassingly sequential and per-client
+    work is identical, so the extrapolation is exact up to noise) — a full
+    CPU round takes >10 minutes on this host."""
+    import torch
+    import torch.nn.functional as F
+
+    if threads:
+        torch.set_num_threads(threads)
+    torch.manual_seed(0)
+    global_model = _narrow_resnet18()
+    local_model = _narrow_resnet18()
+    rng = np.random.RandomState(0)
+    client_data = [
+        (torch.tensor(rng.rand(samples_per_client, 3, 32, 32),
+                      dtype=torch.float32),
+         torch.tensor(rng.randint(0, 10, samples_per_client)))
+        for _ in range(num_clients)]
+    test_x = torch.tensor(rng.rand(test_size, 3, 32, 32),
+                          dtype=torch.float32)
+    test_y = torch.tensor(rng.randint(0, 10, test_size))
+
+    def evaluate(model):
+        model.eval()
+        correct = 0
+        with torch.no_grad():
+            for i in range(0, test_size, batch_size):
+                out = model(test_x[i:i + batch_size])
+                correct += (out.argmax(1) == test_y[i:i + batch_size]).sum()
+        model.train()
+        return correct
+
+    measured = sample_clients or num_clients
+    t0 = time.time()
+    accum = {k: torch.zeros_like(v)
+             for k, v in global_model.state_dict().items()}
+    for (cx, cy) in client_data[:measured]:
+        local_model.load_state_dict(global_model.state_dict())
+        opt = torch.optim.SGD(local_model.parameters(), lr=lr, momentum=0.9,
+                              weight_decay=5e-4)
+        local_model.train()
+        for _ in range(internal_epochs):
+            perm = torch.randperm(len(cx))
+            for i in range(0, len(cx), batch_size):
+                idx = perm[i:i + batch_size]
+                opt.zero_grad()
+                loss = F.cross_entropy(local_model(cx[idx]), cy[idx])
+                loss.backward()
+                opt.step()
+        evaluate(local_model)  # per-client local eval (image_train.py:268)
+        for k, v in local_model.state_dict().items():
+            accum[k] += v - global_model.state_dict()[k]
+    per_client = (time.time() - t0) / measured
+    t1 = time.time()
+    sd = global_model.state_dict()
+    for k in sd:
+        sd[k] = sd[k] + (eta / num_clients) * accum[k]
+    global_model.load_state_dict(sd)
+    evaluate(global_model)     # global eval (main.py:198)
+    tail = time.time() - t1
+    return per_client * num_clients + tail

@@ -1,0 +1,50 @@
+"""Checkpoint/resume via orbax.
+
+Reference parity (helper.py:51-57, :420-435; image_helper.py:56-67): the saved
+unit is {model state, epoch, lr}; resume restores the global model, sets
+start_epoch = saved_epoch + 1 and overwrites the config lr. The canonical use
+is "pretrain clean to epoch N, then attack from the checkpoint"
+(utils/cifar_params.yaml:68-69); `python -m dba_mod_tpu.main pretrain`
+regenerates those clean models since the reference's Google-Drive artifacts
+are external (SURVEY §5 checkpoint row).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from dba_mod_tpu.models import ModelVars
+
+
+def save_checkpoint(path: str | Path, model_vars: ModelVars, epoch: int,
+                    lr: float) -> None:
+    import orbax.checkpoint as ocp
+    path = Path(path).absolute()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"params": model_vars.params,
+                          "batch_stats": model_vars.batch_stats,
+                          "epoch": np.asarray(epoch, np.int64),
+                          "lr": np.asarray(lr, np.float64)},
+                   force=True)
+
+
+def load_checkpoint(path: str | Path,
+                    like: ModelVars) -> Tuple[ModelVars, int, float]:
+    import orbax.checkpoint as ocp
+    path = Path(path).absolute()
+    abstract = {"params": jax.tree_util.tree_map(np.asarray, like.params),
+                "batch_stats": jax.tree_util.tree_map(np.asarray,
+                                                      like.batch_stats),
+                "epoch": np.asarray(0, np.int64),
+                "lr": np.asarray(0, np.float64)}
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+    mv = ModelVars(
+        params=jax.tree_util.tree_map(jax.numpy.asarray, restored["params"]),
+        batch_stats=jax.tree_util.tree_map(jax.numpy.asarray,
+                                           restored["batch_stats"]))
+    return mv, int(restored["epoch"]), float(restored["lr"])

@@ -76,6 +76,7 @@ _DEFAULTS: Dict[str, Any] = {
     "data_dir": "./data",
     "synthetic_data": False,       # force the synthetic dataset backend
     "synthetic_train_size": 0,     # 0 = backend default
+    "synthetic_test_size": 0,      # 0 = backend default
     "num_devices": 0,              # 0 = use all visible devices on the clients mesh
     "run_dir": "./runs",
 }

@@ -37,13 +37,14 @@ class EvalPlan:
 def build_batch_plan(client_indices: Sequence[Sequence[int]],
                      client_epochs: Sequence[int], batch_size: int,
                      rng: np.random.RandomState,
-                     min_steps: int = 1) -> BatchPlan:
-    """Build the [C, E, S, B] plan. E = max(client_epochs); clients with fewer
-    epochs get fully-masked rows beyond their count. Every epoch reshuffles
-    each client's subset (SubsetRandomSampler semantics). Empty clients are
-    fully masked."""
+                     min_steps: int = 1, min_epochs: int = 1) -> BatchPlan:
+    """Build the [C, E, S, B] plan. E = max(client_epochs, min_epochs);
+    clients with fewer epochs get fully-masked rows beyond their count. Every
+    epoch reshuffles each client's subset (SubsetRandomSampler semantics).
+    Empty clients are fully masked. `min_steps`/`min_epochs` pin the plan
+    shape across rounds so the jitted round never recompiles."""
     C = len(client_indices)
-    E = max(1, max(client_epochs, default=1))
+    E = max(min_epochs, max(client_epochs, default=1), 1)
     sizes = np.array([len(ix) for ix in client_indices], np.int32)
     S = max(min_steps, int(np.ceil(sizes.max() / batch_size)) if sizes.max() else min_steps)
     idx = np.zeros((C, E, S, batch_size), np.int64)
@@ -55,8 +56,12 @@ def build_batch_plan(client_indices: Sequence[Sequence[int]],
         arr = np.asarray(indices, np.int64)
         for e in range(min(int(client_epochs[c]), E) if client_epochs[c] else 0):
             shuffled = arr[rng.permutation(n)]
-            padded = np.zeros((S * batch_size,), np.int64)
-            padded[:n] = shuffled
+            # Pad by wrapping the shuffled subset rather than with zeros:
+            # padding rows are masked out of the loss but still flow through
+            # BatchNorm's batch statistics, so they must be real samples of
+            # the same client, not black images.
+            reps = int(np.ceil(S * batch_size / n))
+            padded = np.tile(shuffled, reps)[:S * batch_size]
             idx[c, e] = padded.reshape(S, batch_size)
             m = np.zeros((S * batch_size,), bool)
             m[:n] = True

@@ -256,6 +256,7 @@ def load_image_dataset(params: cfg.Params) -> ImageData:
     if data is None:
         data = synthetic_image_dataset(
             t, train_size=int(params.get("synthetic_train_size", 0) or 0),
+            test_size=int(params.get("synthetic_test_size", 0) or 0),
             seed=int(params.get("random_seed", 1)))
     return data
 

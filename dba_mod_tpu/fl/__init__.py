@@ -1,0 +1,11 @@
+"""FL engine: the client-parallel round computation and its orchestration.
+
+The reference trains clients one at a time in a Python loop sharing a single
+model instance (image_train.py:21-32). Here a *round* is one jitted XLA
+computation: client state is stacked on a leading `clients` axis, local
+training is `vmap`ped (and mesh-sharded, see `dba_mod_tpu.parallel`) over that
+axis, and aggregation consumes the stacked deltas directly — the host only
+schedules, selects agents and records metrics.
+"""
+from dba_mod_tpu.fl.state import ClientTask, RoundHyper
+from dba_mod_tpu.fl.experiment import Experiment

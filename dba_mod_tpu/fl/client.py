@@ -1,0 +1,130 @@
+"""The single-client local-training step — one `lax.scan`, vmapped over the
+clients axis by the round engine.
+
+Capability parity with the reference client loop (image_train.py:21-315,
+loan_train.py:17-261), re-expressed as data-dependent selects so benign and
+poison clients share one compiled program:
+
+- fresh torch-SGD per round (momentum buffers start at zero — the reference
+  constructs a new optimizer per client per round, image_train.py:33, :63);
+- per-internal-epoch LR row (benign constant lr; poison MultiStepLR —
+  image_train.py:66-68, 118-119);
+- loss = α·CE + (1-α)·‖w - w_global‖ (image_train.py:85-90);
+- batch poisoning of the first `poisoning_per_batch` samples
+  (image_helper.py:298-326);
+- FoolsGold per-parameter gradient accumulation across every batch
+  (image_train.py:94-100);
+- model-replacement scaling epilogue w ← w_g + γ·(w - w_g) over the FULL
+  state including BN stats (image_train.py:166-171 scales the state_dict);
+- emits Δ = w_final - w_global over the full state (image_train.py:301-311).
+
+Per-epoch train metrics (loss sum, correct, count, poisoned count) are
+accumulated with scatter-adds for CSV-schema parity (csv_record.train_result).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.models import ModelDef, ModelVars
+from dba_mod_tpu.fl.device_data import DeviceData
+from dba_mod_tpu.fl.state import ClientTask, RoundHyper
+from dba_mod_tpu.ops.losses import cross_entropy, tree_dist_norm
+from dba_mod_tpu.ops.sgd import sgd_init, sgd_step
+
+
+class ClientMetrics(NamedTuple):
+    loss_sum: jax.Array      # [E] Σ batch-mean losses (reference total_loss)
+    correct: jax.Array       # [E] correct predictions
+    count: jax.Array         # [E] samples seen (reference dataset_size)
+    poison_count: jax.Array  # [E] poisoned samples seen
+
+
+class ClientResult(NamedTuple):
+    delta: ModelVars         # w_final - w_global (post-scaling), full state
+    fg_grads: Any            # accumulated grads (params tree) or zeros
+    fg_feature: jax.Array    # [L] flattened similarity-layer grad
+    metrics: ClientMetrics
+
+
+def _select_tree(pred, new, old):
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), new, old)
+
+
+def make_client_step(model_def: ModelDef, data: DeviceData,
+                     hyper: RoundHyper, fg_enabled: bool):
+    """Returns client_step(global_vars, task_row, idx[E,S,B], mask[E,S,B],
+    rng) -> ClientResult, suitable for vmap over (task_row, idx, mask, rng)."""
+
+    def client_step(global_vars: ModelVars, task: ClientTask, idx, mask,
+                    rng) -> ClientResult:
+        E, S, B = idx.shape
+        params0, bn0 = global_vars.params, global_vars.batch_stats
+        mom0 = sgd_init(params0)
+        fg0 = jax.tree_util.tree_map(jnp.zeros_like, params0)
+        zeros_e = jnp.zeros((E,), jnp.float32)
+        metrics0 = ClientMetrics(zeros_e, zeros_e, zeros_e, zeros_e)
+
+        def step(carry, inp):
+            params, bn, mom, fg, m = carry
+            step_i, bidx, bmask = inp
+            e = step_i // S
+            x, y = data.fetch_train(task.slot, bidx)
+            x, y, sel = data.stamp(x, y, task.adv_index,
+                                   task.poisoning_per_batch)
+            step_rng = jax.random.fold_in(rng, step_i)
+
+            def loss_fn(p):
+                logits, new_bn = model_def.apply(
+                    ModelVars(p, bn), x, train=True, dropout_rng=step_rng)
+                ce = cross_entropy(logits, y, bmask)
+                dist = tree_dist_norm(p, params0)
+                loss = task.alpha * ce + (1.0 - task.alpha) * dist
+                return loss, (logits, new_bn)
+
+            (loss, (logits, new_bn)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            lr = task.lr_row[e]
+            new_params, new_mom = sgd_step(params, grads, mom, lr,
+                                           hyper.momentum, hyper.weight_decay)
+            # Padded steps (mask all-false: epochs beyond this client's count,
+            # or steps beyond its batches) must be no-ops.
+            valid = jnp.sum(bmask) > 0
+            params = _select_tree(valid, new_params, params)
+            bn = _select_tree(valid, new_bn, bn)
+            mom = _select_tree(valid, new_mom, mom)
+            if fg_enabled:
+                fg = _select_tree(
+                    valid, jax.tree_util.tree_map(jnp.add, fg, grads), fg)
+
+            preds = jnp.argmax(logits, axis=-1)
+            bmaskf = bmask.astype(jnp.float32)
+            vf = valid.astype(jnp.float32)
+            m = ClientMetrics(
+                loss_sum=m.loss_sum.at[e].add(vf * loss),
+                correct=m.correct.at[e].add(
+                    vf * jnp.sum((preds == y) * bmaskf)),
+                count=m.count.at[e].add(vf * jnp.sum(bmaskf)),
+                poison_count=m.poison_count.at[e].add(
+                    vf * jnp.sum(sel * bmaskf)))
+            return (params, bn, mom, fg, m), None
+
+        xs = (jnp.arange(E * S), idx.reshape(E * S, B),
+              mask.reshape(E * S, B))
+        (params, bn, _mom, fg, metrics), _ = jax.lax.scan(
+            step, (params0, bn0, mom0, fg0, metrics0), xs)
+
+        # Model-replacement scaling over the FULL state (image_train.py:166-171
+        # iterates state_dict — BN stats included), then Δ = w_scaled - w_g.
+        delta = ModelVars(
+            params=jax.tree_util.tree_map(
+                lambda w, g: task.scale * (w - g), params, params0),
+            batch_stats=jax.tree_util.tree_map(
+                lambda w, g: task.scale * (w - g), bn, bn0))
+        fg_feature = model_def.similarity_param(fg).reshape(-1)
+        return ClientResult(delta, fg, fg_feature, metrics)
+
+    return client_step

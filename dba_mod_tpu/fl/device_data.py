@@ -1,0 +1,96 @@
+"""Device-resident datasets and the fetch/stamp closures used by the client
+step.
+
+Datasets live on device once (images as uint8 to halve HBM traffic; scaled to
+[0,1] at gather time, matching the reference's ToTensor()-only pipeline,
+image_helper.py:178-201). A batch fetch is a single XLA gather — the host
+never touches sample data during training (contrast image_helper.py:289-296,
+which moves every batch host→GPU).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.data.batching import stack_ragged
+from dba_mod_tpu.data.datasets import ImageData, LoanData
+from dba_mod_tpu.ops import triggers
+
+# fetch(slot, idx[B]) -> (x[B, ...], y[B]); stamp(x, y, adv_index, k,
+# poison_all) -> (x, y, poisoned_mask)
+FetchFn = Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]
+StampFn = Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]
+
+
+@dataclasses.dataclass
+class DeviceData:
+    fetch_train: FetchFn
+    fetch_test: FetchFn
+    stamp: StampFn
+    num_train: int
+    num_test: int
+    compute_dtype: jnp.dtype
+
+
+def make_image_device_data(data: ImageData, params: cfg.Params,
+                           compute_dtype=jnp.float32) -> DeviceData:
+    train_x = jnp.asarray(data.train_images)          # [N,H,W,C] uint8
+    train_y = jnp.asarray(data.train_labels.astype(np.int32))
+    test_x = jnp.asarray(data.test_images)
+    test_y = jnp.asarray(data.test_labels.astype(np.int32))
+    h, w = data.train_images.shape[1:3]
+    bank = jnp.asarray(triggers.build_pixel_pattern_bank(params, h, w),
+                       compute_dtype)
+    swap = int(params["poison_label_swap"])
+
+    def fetch_train(slot, idx):
+        x = train_x[idx].astype(compute_dtype) / 255.0
+        return x, train_y[idx]
+
+    def fetch_test(slot, idx):
+        x = test_x[idx].astype(compute_dtype) / 255.0
+        return x, test_y[idx]
+
+    def stamp(x, y, adv_index, k, poison_all=False):
+        return triggers.poison_batch(x, y, bank, adv_index, swap, k,
+                                     poison_all)
+
+    return DeviceData(fetch_train, fetch_test, stamp,
+                      num_train=len(data.train_labels),
+                      num_test=len(data.test_labels),
+                      compute_dtype=compute_dtype)
+
+
+def make_loan_device_data(data: LoanData, params: cfg.Params,
+                          compute_dtype=jnp.float32) -> DeviceData:
+    """LOAN shards are ragged per state → stacked [S, max_n, F] with per-state
+    row counts carried by the batch plans' masks. `slot` selects the state."""
+    train_x = jnp.asarray(stack_ragged(data.train_x), compute_dtype)
+    train_y = jnp.asarray(stack_ragged(data.train_y).astype(np.int32))
+    test_x = jnp.asarray(stack_ragged(data.test_x), compute_dtype)
+    test_y = jnp.asarray(stack_ragged(data.test_y).astype(np.int32))
+    values, masks = triggers.build_feature_trigger_bank(
+        params, data.feature_dict, train_x.shape[-1])
+    values = jnp.asarray(values, compute_dtype)
+    masks = jnp.asarray(masks, compute_dtype)
+    swap = int(params["poison_label_swap"])
+
+    def fetch_train(slot, idx):
+        return train_x[slot, idx], train_y[slot, idx]
+
+    def fetch_test(slot, idx):
+        return test_x[slot, idx], test_y[slot, idx]
+
+    def stamp(x, y, adv_index, k, poison_all=False):
+        return triggers.poison_batch_features(x, y, values, masks, adv_index,
+                                              swap, k, poison_all)
+
+    return DeviceData(fetch_train, fetch_test, stamp,
+                      num_train=sum(len(y) for y in data.train_y),
+                      num_test=sum(len(y) for y in data.test_y),
+                      compute_dtype=compute_dtype)

@@ -1,0 +1,68 @@
+"""The evaluation battery — jitted equivalents of reference test.py.
+
+Four reference entry points map to two jitted kernels:
+- `Mytest` (test.py:7-51)                     → evaluate(poison=False)
+- `Mytest_poison` (test.py:54-115)            → evaluate(poison=True, adv=-1)
+- `Mytest_poison_trigger` (test.py:118-177)   → evaluate(poison=True, adv=j)
+- `Mytest_poison_agent_trigger` (:180-239)    → evaluate(poison=True, adv=slot)
+
+Semantics preserved: loss is a reduction='sum' divided by the count
+(test.py:21-22, :40); poisoned accuracy divides by `poison_data_count`
+(test.py:105), which equals the valid-sample count since evaluation poisons
+every sample; the poisoned image eval runs on the test set with target-label
+images dropped (image_helper.py:148-172), expressed in the eval plan's index
+set; the LOAN branches iterate every state shard (test.py:13-24) — here the
+plan concatenates all shards with a per-row slot array.
+
+Local (per-client) evals vmap the same kernel over stacked client models —
+ten models' test passes in one XLA computation instead of the reference's
+sequential loop.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.models import ModelDef, ModelVars
+from dba_mod_tpu.fl.device_data import DeviceData
+from dba_mod_tpu.ops.losses import cross_entropy_sum
+
+
+class EvalResult(NamedTuple):
+    loss: jax.Array      # average loss (sum / count)
+    acc: jax.Array       # percentage
+    correct: jax.Array
+    count: jax.Array     # dataset_size / poison_data_count
+
+
+def make_eval_fn(model_def: ModelDef, data: DeviceData, poison: bool):
+    """evaluate(model_vars, idx[S,B], slots[S,B], mask[S,B], adv_index)
+    -> EvalResult. `poison` is static: True stamps every sample with trigger
+    `adv_index` and swaps labels (test.py:95, evaluation=True)."""
+
+    def evaluate(model_vars: ModelVars, idx, slots, mask,
+                 adv_index) -> EvalResult:
+        def body(carry, inp):
+            loss_sum, correct, count = carry
+            bidx, bslot, bmask = inp
+            x, y = data.fetch_test(bslot, bidx)
+            if poison:
+                x, y, _ = data.stamp(x, y, adv_index, 0, poison_all=True)
+            logits, _ = model_def.apply(model_vars, x, train=False)
+            bmaskf = bmask.astype(jnp.float32)
+            loss_sum += cross_entropy_sum(logits, y, bmask)
+            preds = jnp.argmax(logits, axis=-1)
+            correct += jnp.sum((preds == y) * bmaskf)
+            count += jnp.sum(bmaskf)
+            return (loss_sum, correct, count), None
+
+        (loss_sum, correct, count), _ = jax.lax.scan(
+            body, (jnp.float32(0), jnp.float32(0), jnp.float32(0)),
+            (idx, slots, mask))
+        safe = jnp.maximum(count, 1.0)
+        return EvalResult(loss=loss_sum / safe, acc=100.0 * correct / safe,
+                          correct=correct, count=count)
+
+    return evaluate

@@ -1,0 +1,392 @@
+"""The end-to-end FL experiment driver — the TPU-native main.py.
+
+Replaces the reference's __main__ round loop (main.py:84-244) with a class:
+data loading + partitioning once at startup, then per round: host-side agent
+selection and plan building, one jitted round computation (train all clients →
+aggregate), jitted local/global evaluation batteries, and recording. No import
+cycles, no global mutable state (SURVEY §1 layer-crossing notes, §7.3).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu import checkpoint as ckpt
+from dba_mod_tpu.data import (build_batch_plan, build_eval_plan,
+                              load_image_dataset, load_loan_dataset)
+from dba_mod_tpu.data.partition import (equal_split_indices,
+                                        poison_test_indices,
+                                        sample_dirichlet_indices)
+from dba_mod_tpu.fl.device_data import (make_image_device_data,
+                                        make_loan_device_data)
+from dba_mod_tpu.fl.rounds import EvalPlans, RoundEngine
+from dba_mod_tpu.fl.selection import select_agents
+from dba_mod_tpu.fl.state import build_client_tasks
+from dba_mod_tpu.models import ModelVars, build_model
+from dba_mod_tpu.ops.aggregation import foolsgold_init
+from dba_mod_tpu.utils.recorder import Recorder
+
+logger = logging.getLogger("dba_mod_tpu")
+
+
+def _pad_tasks(tasks, pad: int, epochs_max: int):
+    """Append `pad` inert clients (fully-masked plans → zero deltas) so the
+    stacked axis tiles the mesh. Sound only for FedAvg (static no_models
+    divisor); the caller enforces that."""
+    from dba_mod_tpu.fl.state import ClientTask
+    return ClientTask(
+        slot=np.pad(tasks.slot, (0, pad)),
+        participant_id=np.pad(tasks.participant_id, (0, pad)),
+        adv_index=np.pad(tasks.adv_index, (0, pad), constant_values=-1),
+        adv_slot=np.pad(tasks.adv_slot, (0, pad), constant_values=-1),
+        poisoning_per_batch=np.pad(tasks.poisoning_per_batch, (0, pad)),
+        alpha=np.pad(tasks.alpha, (0, pad), constant_values=1.0),
+        scale=np.pad(tasks.scale, (0, pad), constant_values=1.0),
+        lr_row=np.pad(tasks.lr_row, ((0, pad), (0, 0))),
+        num_epochs=np.pad(tasks.num_epochs, (0, pad)))
+
+
+class Experiment:
+    def __init__(self, params: cfg.Params, save_results: bool = True):
+        self.params = params
+        self.folder: Optional[Path] = (params.make_run_folder()
+                                       if save_results else None)
+        if self.folder and not logger.handlers:
+            logging.basicConfig(level=logging.INFO)
+            logger.addHandler(logging.FileHandler(self.folder / "log.txt"))
+        self.recorder = Recorder(self.folder)
+        self.model_def = build_model(params)
+        seed = int(params.get("random_seed", 1))
+        self.select_rng = random.Random(seed)
+        self.plan_rng = np.random.RandomState(seed)
+        self.rng_key = jax.random.key(seed)
+
+        self._load_data_and_partition(seed)
+
+        # Fixed plan shape across rounds → the jitted round compiles once.
+        max_client = max((len(v) for v in self.client_indices.values()),
+                         default=1)
+        b = int(params["batch_size"])
+        self.steps_per_epoch = max(1, int(np.ceil(max_client / b)))
+        self.is_poison_run = bool(params["is_poison"])
+        self.epochs_max = (max(int(params["internal_epochs"]),
+                               int(params["internal_poison_epochs"]))
+                           if self.is_poison_run
+                           else int(params["internal_epochs"]))
+
+        # Global model: fresh init or resume (image_helper.py:56-67)
+        init_rng = jax.random.key(seed)
+        self.global_vars = self.model_def.init_vars(init_rng)
+        self.start_epoch = 1
+        if params["resumed_model"]:
+            path = Path("saved_models") / str(params["resumed_model_name"])
+            self.global_vars, saved_epoch, saved_lr = ckpt.load_checkpoint(
+                path, self.global_vars)
+            self.start_epoch = saved_epoch + 1
+            self.params.raw["lr"] = saved_lr
+            logger.info("resumed %s: lr=%s start_epoch=%d", path, saved_lr,
+                        self.start_epoch)
+
+        # clients mesh: 0 → single-device; -1 → all visible devices; n → n
+        nd = int(params.get("num_devices", 0))
+        self.mesh = None
+        if nd == -1 or nd > 1:
+            from dba_mod_tpu.parallel.mesh import make_mesh
+            self.mesh = make_mesh(0 if nd == -1 else nd)
+
+        self.engine = RoundEngine(params, self.model_def, self.device_data,
+                                  self.eval_plans, mesh=self.mesh)
+        grad_len = int(np.prod(
+            self.model_def.similarity_param(self.global_vars.params).shape))
+        self.fg_state = foolsgold_init(self.num_participants, grad_len)
+        self.local_eval = bool(params.get("local_eval", True))
+
+    # ------------------------------------------------------------------ data
+    def _load_data_and_partition(self, seed: int):
+        params = self.params
+        if params.is_image:
+            data = self.image_data = load_image_dataset(params)
+            self.device_data = make_image_device_data(data, params)
+            if params["sampling_dirichlet"]:
+                indices = sample_dirichlet_indices(
+                    data.train_labels,
+                    int(params["number_of_total_participants"]),
+                    float(params["dirichlet_alpha"]),
+                    py_rng=random.Random(seed),
+                    np_rng=np.random.RandomState(seed))
+            else:
+                indices = equal_split_indices(
+                    len(data.train_labels),
+                    int(params["number_of_total_participants"]),
+                    py_rng=random.Random(seed))
+            self.client_indices = indices
+            self.client_slots = {name: 0 for name in indices}
+            if params["is_random_namelist"]:
+                self.participants = list(
+                    range(int(params["number_of_total_participants"])))
+            else:
+                self.participants = list(params["participants_namelist"])
+            self.benign_names = sorted(
+                set(self.participants) - set(params.adversary_list))
+            self.num_participants = int(
+                params["number_of_total_participants"])
+
+            clean = build_eval_plan(np.arange(len(data.test_labels)),
+                                    int(params["batch_size"]))
+            poison = build_eval_plan(
+                poison_test_indices(data.test_labels,
+                                    int(params["poison_label_swap"])),
+                int(params["batch_size"]))
+            self.eval_plans = EvalPlans(
+                clean_idx=jnp.asarray(clean.idx),
+                clean_slots=jnp.zeros_like(jnp.asarray(clean.idx)),
+                clean_mask=jnp.asarray(clean.mask),
+                poison_idx=jnp.asarray(poison.idx),
+                poison_slots=jnp.zeros_like(jnp.asarray(poison.idx)),
+                poison_mask=jnp.asarray(poison.mask))
+        else:
+            data = self.loan_data = load_loan_dataset(params)
+            self.device_data = make_loan_device_data(data, params)
+            state_of = {n: i for i, n in enumerate(data.state_names)}
+            # benign list: first `number_of_total_participants` shards that
+            # are not adversaries (loan_helper.py:134-141)
+            benign = []
+            for j, name in enumerate(data.state_names):
+                if j >= int(params["number_of_total_participants"]):
+                    break
+                if name not in params.adversary_list:
+                    benign.append(name)
+            self.benign_names = benign
+            if params["is_random_namelist"]:
+                self.participants = benign + params.adversary_list
+            else:
+                self.participants = list(params["participants_namelist"])
+            self.client_indices = {
+                name: list(range(len(data.train_y[state_of[name]])))
+                for name in data.state_names}
+            self.client_slots = state_of
+            self.num_participants = len(data.state_names)
+
+            # eval plans concatenate every state shard (test.py:13-24)
+            b = int(params["batch_size"])
+            pairs = [(s, i) for s, ys in enumerate(data.test_y)
+                     for i in range(len(ys))]
+            slots = np.array([p[0] for p in pairs], np.int64)
+            rows = np.array([p[1] for p in pairs], np.int64)
+            plan = build_eval_plan(np.arange(len(pairs)), b)
+            # map flat eval positions back to (slot, row)
+            idx = rows[plan.idx.reshape(-1)].reshape(plan.idx.shape)
+            slt = slots[plan.idx.reshape(-1)].reshape(plan.idx.shape)
+            self.eval_plans = EvalPlans(
+                clean_idx=jnp.asarray(idx.astype(np.int32)),
+                clean_slots=jnp.asarray(slt.astype(np.int32)),
+                clean_mask=jnp.asarray(plan.mask),
+                poison_idx=jnp.asarray(idx.astype(np.int32)),
+                poison_slots=jnp.asarray(slt.astype(np.int32)),
+                poison_mask=jnp.asarray(plan.mask))
+
+    # ----------------------------------------------------------------- round
+    def run_round(self, epoch: int) -> Dict[str, Any]:
+        params = self.params
+        t0 = time.time()
+        agent_names, adv_names = select_agents(
+            params, epoch, self.participants, self.benign_names,
+            self.select_rng)
+        logger.info("Server Epoch:%d choose agents: %s", epoch, agent_names)
+
+        backdoor_acc = None
+        if (params.type == cfg.TYPE_LOAN and self.is_poison_run
+                and any(params.adversary_slot_of(n) >= 0 and
+                        epoch in params.poison_epochs_for(
+                            params.adversary_slot_of(n))
+                        for n in agent_names)):
+            backdoor_acc = float(self.engine.backdoor_acc_fn(
+                self.global_vars))
+
+        slots = np.array([self.client_slots[n] for n in agent_names],
+                         np.int64)
+        tasks = build_client_tasks(params, agent_names, epoch, slots,
+                                   self.epochs_max, backdoor_acc)
+        client_epochs = [int(e) for e in tasks.num_epochs]
+        plan = build_batch_plan(
+            [self.client_indices[n] for n in agent_names], client_epochs,
+            int(params["batch_size"]), self.plan_rng,
+            min_steps=self.steps_per_epoch, min_epochs=self.epochs_max)
+
+        self.rng_key, round_key = jax.random.split(self.rng_key)
+        idx_np, mask_np = plan.idx, plan.mask
+        num_samples_np = plan.num_samples.astype(np.float32)
+        if self.mesh is not None:
+            from dba_mod_tpu.parallel.mesh import (pad_clients,
+                                                   shard_round_inputs)
+            c_pad = pad_clients(len(agent_names), self.mesh)
+            if c_pad != len(agent_names):
+                if params.aggregation != cfg.AGGR_MEAN:
+                    raise ValueError(
+                        f"no_models={len(agent_names)} does not tile the "
+                        f"{self.mesh.devices.size}-device mesh; pick a "
+                        "multiple (inert-client padding is only sound for "
+                        "FedAvg, whose divisor is the static no_models)")
+                pad = c_pad - len(agent_names)
+                tasks = _pad_tasks(tasks, pad, self.epochs_max)
+                idx_np = np.pad(idx_np, ((0, pad),) + ((0, 0),) * 3)
+                mask_np = np.pad(mask_np, ((0, pad),) + ((0, 0),) * 3)
+                num_samples_np = np.pad(num_samples_np, (0, pad))
+            tasks_dev, idx_dev, mask_dev, ns_dev = shard_round_inputs(
+                self.mesh, jax.tree_util.tree_map(jnp.asarray, tasks),
+                jnp.asarray(idx_np), jnp.asarray(mask_np),
+                jnp.asarray(num_samples_np))
+        else:
+            tasks_dev = jax.tree_util.tree_map(jnp.asarray, tasks)
+            idx_dev, mask_dev = jnp.asarray(idx_np), jnp.asarray(mask_np)
+            ns_dev = jnp.asarray(num_samples_np)
+        result = self.engine.round_fn(
+            self.global_vars, self.fg_state, tasks_dev,
+            idx_dev, mask_dev, ns_dev, round_key)
+
+        locals_ = None
+        if self.local_eval:
+            locals_ = jax.device_get(self.engine.local_evals_fn(
+                self.global_vars, result.deltas, tasks_dev))
+
+        self.global_vars = result.new_vars
+        self.fg_state = result.new_fg_state
+        globals_ = jax.device_get(self.engine.global_evals_fn(
+            self.global_vars))
+        metrics = jax.device_get(result.metrics)
+        delta_norms = np.asarray(result.delta_norms)
+        wv = np.asarray(result.wv)
+        alpha = np.asarray(result.alpha)
+
+        self._record(epoch, agent_names, adv_names, tasks, metrics, locals_,
+                     globals_, delta_norms, wv, alpha, t0)
+        return {"epoch": epoch, "agents": agent_names,
+                "global_acc": float(globals_.clean.acc),
+                "backdoor_acc": (float(globals_.poison.acc)
+                                 if self.is_poison_run else None),
+                "round_time": time.time() - t0}
+
+    # ------------------------------------------------------------- recording
+    def _record(self, epoch, agent_names, adv_names, tasks, metrics, locals_,
+                globals_, delta_norms, wv, alpha, t0):
+        params = self.params
+        rec = self.recorder
+        for c, name in enumerate(agent_names):
+            n_e = int(tasks.num_epochs[c])
+            for e in range(n_e):
+                count = max(float(metrics.count[c, e]), 1.0)
+                rec.add_train(name, (epoch - 1) * n_e + e + 1, epoch, e + 1,
+                              float(metrics.loss_sum[c, e]) / count,
+                              100.0 * float(metrics.correct[c, e]) / count,
+                              int(metrics.correct[c, e]), int(count))
+            poisoning = int(tasks.poisoning_per_batch[c]) > 0
+            baseline = bool(params["baseline"])
+            if locals_ is not None:
+                lr = locals_
+                # the local clean eval for a poisoning client happens inside
+                # `if not baseline` in the reference (image_train.py:148-155);
+                # benign clients always get one (:267-271)
+                if not (poisoning and baseline):
+                    rec.add_test(name, epoch, float(lr.clean.loss[c]),
+                                 float(lr.clean.acc[c]),
+                                 int(lr.clean.correct[c]),
+                                 int(lr.clean.count[c]))
+                if poisoning and self.is_poison_run:
+                    if not baseline:
+                        rec.add_poisontest(name, epoch,
+                                           float(lr.poison_pre.loss[c]),
+                                           float(lr.poison_pre.acc[c]),
+                                           int(lr.poison_pre.correct[c]),
+                                           int(lr.poison_pre.count[c]))
+                    rec.add_poisontest(name, epoch,
+                                       float(lr.poison_post.loss[c]),
+                                       float(lr.poison_post.acc[c]),
+                                       int(lr.poison_post.correct[c]),
+                                       int(lr.poison_post.count[c]))
+                if (self.is_poison_run and
+                        int(tasks.adv_slot[c]) >= 0):
+                    rec.add_triggertest(
+                        name, f"{name}_trigger", "", epoch,
+                        float(lr.agent_trigger.loss[c]),
+                        float(lr.agent_trigger.acc[c]),
+                        int(lr.agent_trigger.correct[c]),
+                        int(lr.agent_trigger.count[c]))
+            if poisoning and not baseline:
+                rec.scale_temp_one_row.extend(
+                    [epoch, round(float(delta_norms[c]), 4)])
+
+        rec.add_test("global", epoch, float(globals_.clean.loss),
+                     float(globals_.clean.acc), int(globals_.clean.correct),
+                     int(globals_.clean.count))
+        if self.is_poison_run:
+            g = globals_
+            rec.add_poisontest("global", epoch, float(g.poison.loss),
+                               float(g.poison.acc), int(g.poison.correct),
+                               int(g.poison.count))
+            rec.add_triggertest("global", "combine", "", epoch,
+                                float(g.poison.loss), float(g.poison.acc),
+                                int(g.poison.correct), int(g.poison.count))
+            if params.is_centralized_attack:
+                # gated on centralized_test_trigger (main.py:226)
+                names = [f"global_in_index_{j}_trigger"
+                         for j in range(self.engine.num_global_triggers)]
+            else:
+                names = [f"global_in_{a}_trigger"
+                         for a in params.adversary_list]
+            for j, tname in enumerate(names):
+                rec.add_triggertest(
+                    "global", tname, "", epoch,
+                    float(g.per_trigger.loss[j]), float(g.per_trigger.acc[j]),
+                    int(g.per_trigger.correct[j]),
+                    int(g.per_trigger.count[j]))
+        if rec.scale_temp_one_row:
+            rec.scale_temp_one_row.append(round(float(globals_.clean.acc), 4))
+        if self.params.aggregation != cfg.AGGR_MEAN:
+            rec.add_weight_result(list(agent_names), wv.tolist(),
+                                  alpha.tolist())
+        rec.add_round_json(
+            epoch=epoch, agents=[str(a) for a in agent_names],
+            adversaries=[str(a) for a in adv_names],
+            global_acc=float(globals_.clean.acc),
+            global_loss=float(globals_.clean.loss),
+            backdoor_acc=(float(globals_.poison.acc)
+                          if self.is_poison_run else None),
+            round_time=time.time() - t0)
+        rec.save(self.is_poison_run)
+
+    # ------------------------------------------------------------------- run
+    def save_model(self, epoch: int):
+        params = self.params
+        if not params["save_model"] or self.folder is None:
+            return
+        path = self.folder / "model_last.pt.tar"
+        ckpt.save_checkpoint(path, self.global_vars, epoch,
+                             float(params["lr"]))
+        if epoch in list(params["save_on_epochs"]):
+            ckpt.save_checkpoint(Path(str(path) + f".epoch_{epoch}"),
+                                 self.global_vars, epoch,
+                                 float(params["lr"]))
+
+    def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
+        last: Dict[str, Any] = {}
+        end = epochs if epochs is not None else int(self.params["epochs"])
+        interval = int(self.params["aggr_epoch_interval"])
+        if interval != 1:
+            raise NotImplementedError(
+                "aggr_epoch_interval != 1 is not supported yet (all reference "
+                "configs use 1; see utils/*_params.yaml)")
+        for epoch in range(self.start_epoch, end + 1, interval):
+            last = self.run_round(epoch)
+            self.save_model(epoch)
+            logger.info("epoch %d done in %.2fs acc=%.2f backdoor=%s",
+                        epoch, last["round_time"], last["global_acc"],
+                        last["backdoor_acc"])
+        return last

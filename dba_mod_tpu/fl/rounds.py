@@ -1,0 +1,226 @@
+"""The round engine: one jitted XLA computation per FL round, plus the jitted
+local/global evaluation batteries.
+
+Replaces main.py:135-231's sequential orchestration: the round computation
+vmaps the client step over the stacked clients axis, feeds the stacked deltas
+straight into the configured aggregator, and returns the new global state —
+server→client broadcast and client→server upload are XLA data flow, not
+host dict-copies (contrast image_train.py:32, helper.py:223-227).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.models import ModelDef, ModelVars
+from dba_mod_tpu.fl.client import ClientMetrics, make_client_step
+from dba_mod_tpu.fl.device_data import DeviceData
+from dba_mod_tpu.fl.evaluation import EvalResult, make_eval_fn
+from dba_mod_tpu.fl.state import ClientTask, RoundHyper
+from dba_mod_tpu.ops import aggregation as agg
+
+
+class RoundResult(NamedTuple):
+    new_vars: ModelVars
+    new_fg_state: agg.FoolsGoldState
+    metrics: ClientMetrics        # stacked [C, E]
+    deltas: ModelVars             # stacked [C, ...] (for local evals)
+    delta_norms: jax.Array        # [C] ‖Δ_params‖ — scale_result.csv distance
+    wv: jax.Array                 # [C] aggregation weights (RFA/FoolsGold)
+    alpha: jax.Array              # [C] RFA distances / FoolsGold alphas
+    num_oracle_calls: jax.Array   # RFA oracle counter (1 otherwise)
+
+
+class LocalEvals(NamedTuple):
+    """Per-client local-model eval rows (all [C]): reference CSV parity.
+    clean/pre-scale rows evaluate the unscaled model (image_train.py:150-164
+    runs Mytest/Mytest_poison BEFORE scaling); post rows the submitted one."""
+    clean: EvalResult             # test_result rows (image_train.py:268-271)
+    poison_pre: EvalResult        # posiontest_result pre-scale (:157-164)
+    poison_post: EvalResult       # posiontest_result post-scale (:275-282)
+    agent_trigger: EvalResult     # poisontriggertest_result (:291-295)
+
+
+class GlobalEvals(NamedTuple):
+    clean: EvalResult             # Mytest(global) (main.py:198-201)
+    poison: EvalResult            # Mytest_poison(global) (main.py:207-215)
+    per_trigger: EvalResult       # [T] rows (main.py:225-231)
+
+
+@dataclasses.dataclass
+class EvalPlans:
+    """Device-resident eval index plans, built once per experiment."""
+    clean_idx: jax.Array      # [S, B]
+    clean_slots: jax.Array
+    clean_mask: jax.Array
+    poison_idx: jax.Array     # [S', B] — target-label samples dropped
+    poison_slots: jax.Array
+    poison_mask: jax.Array
+
+
+class RoundEngine:
+    """Holds the jitted round + eval computations for one experiment config.
+
+    With a mesh, the stacked clients axis is sharded across devices (GSPMD via
+    jit in_shardings): each device trains its clients locally and the
+    aggregation reductions lower to ICI collectives (SURVEY §2.2)."""
+
+    def __init__(self, params: cfg.Params, model_def: ModelDef,
+                 data: DeviceData, plans: EvalPlans, mesh=None):
+        self.params = params
+        self.hyper = RoundHyper.from_params(params)
+        self.model_def = model_def
+        self.data = data
+        self.plans = plans
+        self.mesh = mesh
+        hyper = self.hyper
+        fg_enabled = hyper.aggregation == cfg.AGGR_FOOLSGOLD
+        client_step = make_client_step(model_def, data, hyper, fg_enabled)
+        eval_clean = make_eval_fn(model_def, data, poison=False)
+        eval_poison = make_eval_fn(model_def, data, poison=True)
+        is_poison_run = bool(params["is_poison"])
+
+        def round_fn(global_vars: ModelVars, fg_state: agg.FoolsGoldState,
+                     tasks: ClientTask, idx, mask, num_samples,
+                     rng) -> RoundResult:
+            C = idx.shape[0]
+            rng, dp_rng = jax.random.split(rng)
+            client_rngs = jax.random.split(rng, C)
+            res = jax.vmap(client_step, in_axes=(None, 0, 0, 0, 0))(
+                global_vars, tasks, idx, mask, client_rngs)
+
+            wv = jnp.zeros((C,), jnp.float32)
+            alpha = jnp.zeros((C,), jnp.float32)
+            calls = jnp.int32(1)
+            new_fg = fg_state
+            if hyper.aggregation == cfg.AGGR_MEAN:
+                new_vars = agg.fedavg_update(
+                    global_vars, res.delta, hyper.eta, hyper.no_models,
+                    hyper.sigma if hyper.diff_privacy else 0.0, dp_rng)
+            elif hyper.aggregation == cfg.AGGR_GEO_MED:
+                r = agg.geometric_median_update(
+                    global_vars, res.delta, num_samples, hyper.eta,
+                    maxiter=hyper.geom_median_maxiter,
+                    max_update_norm=hyper.max_update_norm,
+                    dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
+                    rng=dp_rng)
+                new_vars, calls, wv, alpha = (r.new_state, r.num_oracle_calls,
+                                              r.wv, r.distances)
+            else:  # foolsgold
+                r = agg.foolsgold_update(
+                    global_vars.params, res.fg_grads, res.fg_feature,
+                    tasks.participant_id, fg_state, hyper.eta, hyper.lr,
+                    hyper.momentum, hyper.weight_decay,
+                    use_memory=hyper.fg_use_memory)
+                # BN stats are not aggregated by FoolsGold (the reference
+                # steps an optimizer over named_parameters only,
+                # helper.py:286-290)
+                new_vars = ModelVars(r.new_params, global_vars.batch_stats)
+                new_fg, wv, alpha = r.new_fg_state, r.wv, r.alpha
+            from dba_mod_tpu.ops.losses import tree_global_norm
+            delta_norms = jax.vmap(
+                lambda d: tree_global_norm(d.params))(res.delta)
+            return RoundResult(new_vars, new_fg, res.metrics, res.delta,
+                               delta_norms, wv, alpha, calls)
+
+        if mesh is not None:
+            from dba_mod_tpu.parallel.mesh import (client_sharding,
+                                                   replicated_sharding)
+            rep = replicated_sharding(mesh)
+            cs = client_sharding(mesh)
+            # (global_vars, fg_state, tasks, idx, mask, num_samples, rng) —
+            # pytree-prefix shardings; outputs left to the partitioner.
+            self.round_fn = jax.jit(
+                round_fn, in_shardings=(rep, rep, cs, cs, cs, cs, rep))
+        else:
+            self.round_fn = jax.jit(round_fn)
+
+        def local_evals(global_vars: ModelVars, deltas: ModelVars,
+                        tasks: ClientTask) -> LocalEvals:
+            def per_client(delta: ModelVars, scale, adv_slot):
+                unscaled = jax.tree_util.tree_map(
+                    lambda g, d: g + d / scale, global_vars, delta)
+                scaled = jax.tree_util.tree_map(
+                    lambda g, d: g + d, global_vars, delta)
+                clean = eval_clean(unscaled, plans.clean_idx,
+                                   plans.clean_slots, plans.clean_mask,
+                                   jnp.int32(-1))
+                if is_poison_run:
+                    pre = eval_poison(unscaled, plans.poison_idx,
+                                      plans.poison_slots, plans.poison_mask,
+                                      jnp.int32(-1))
+                    post = eval_poison(scaled, plans.poison_idx,
+                                       plans.poison_slots, plans.poison_mask,
+                                       jnp.int32(-1))
+                    agent = eval_poison(scaled, plans.poison_idx,
+                                        plans.poison_slots, plans.poison_mask,
+                                        adv_slot)
+                else:
+                    zero = EvalResult(*(jnp.float32(0),) * 4)
+                    pre = post = agent = zero
+                return LocalEvals(clean, pre, post, agent)
+
+            return jax.vmap(per_client, in_axes=(0, 0, 0))(
+                deltas, tasks.scale, tasks.adv_slot)
+
+        if mesh is not None:
+            from dba_mod_tpu.parallel.mesh import (client_sharding,
+                                                   replicated_sharding)
+            self.local_evals_fn = jax.jit(
+                local_evals,
+                in_shardings=(replicated_sharding(mesh),
+                              client_sharding(mesh), client_sharding(mesh)))
+        else:
+            self.local_evals_fn = jax.jit(local_evals)
+
+        # Global per-trigger battery (main.py:225-231): centralized mode tests
+        # each sub-pattern by index — only when `centralized_test_trigger` is
+        # set (main.py:226) — distributed mode tests each adversary's pattern
+        # (= its slot).
+        if params.is_centralized_attack:
+            n_triggers = (int(params["trigger_num"])
+                          if bool(params["centralized_test_trigger"]) else 0)
+        else:
+            n_triggers = params.num_adversaries
+        self.num_global_triggers = n_triggers
+        trigger_ids = jnp.arange(max(n_triggers, 1), dtype=jnp.int32)
+
+        def global_evals(model_vars: ModelVars) -> GlobalEvals:
+            clean = eval_clean(model_vars, plans.clean_idx, plans.clean_slots,
+                               plans.clean_mask, jnp.int32(-1))
+            if is_poison_run:
+                poison = eval_poison(model_vars, plans.poison_idx,
+                                     plans.poison_slots, plans.poison_mask,
+                                     jnp.int32(-1))
+                if n_triggers > 0:
+                    per_trigger = jax.vmap(
+                        lambda t: eval_poison(model_vars, plans.poison_idx,
+                                              plans.poison_slots,
+                                              plans.poison_mask,
+                                              t))(trigger_ids)
+                else:
+                    zero = EvalResult(*(jnp.float32(0),) * 4)
+                    per_trigger = jax.tree_util.tree_map(
+                        lambda z: jnp.zeros((1,)), zero)
+            else:
+                zero = EvalResult(*(jnp.float32(0),) * 4)
+                poison = zero
+                per_trigger = jax.tree_util.tree_map(
+                    lambda z: jnp.zeros((max(n_triggers, 1),)), zero)
+            return GlobalEvals(clean, poison, per_trigger)
+
+        self.global_evals_fn = jax.jit(global_evals)
+
+        def backdoor_acc(model_vars: ModelVars) -> jax.Array:
+            """Combined-trigger backdoor accuracy of the global model — feeds
+            the LOAN adaptive poison LR (loan_train.py:67-75)."""
+            r = eval_poison(model_vars, plans.poison_idx, plans.poison_slots,
+                            plans.poison_mask, jnp.int32(-1))
+            return r.acc
+
+        self.backdoor_acc_fn = jax.jit(backdoor_acc)

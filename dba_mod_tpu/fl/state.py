@@ -1,0 +1,134 @@
+"""State trees for the FL round computation.
+
+SURVEY §7.2.1: the reference mutates torch state_dicts in place everywhere;
+the functional equivalent is an explicit carry. The per-round carry is
+`(ModelVars global, FoolsGoldState, rng)`; everything per-client is a
+`ClientTask` row stacked on the clients axis.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+
+
+class ClientTask(NamedTuple):
+    """Per-client round inputs; every field stacked to [C] (lr_row to [C, E]).
+
+    Encodes the reference's per-client branching (benign vs poison path,
+    image_train.py:56-191) as data so one jitted computation serves all
+    clients:
+      - benign lane: poisoning_per_batch=0, alpha=1, scale=1, lr_row=lr
+      - poison lane: poisoning_per_batch=k, alpha=alpha_loss, scale=
+        scale_weights_poison (1 when `baseline`), lr_row=poison MultiStepLR
+    """
+    slot: jax.Array              # i32 — data shard slot (LOAN state index)
+    participant_id: jax.Array    # i32 — global participant id (FoolsGold memory)
+    adv_index: jax.Array         # i32 — trigger bank row; -1 = combined/global
+    adv_slot: jax.Array          # i32 — position in adversary_list, -1 benign
+                                 #       (keys the local-trigger eval even in
+                                 #       centralized mode, test.py:218-223)
+    poisoning_per_batch: jax.Array  # i32 — 0 disables poisoning
+    alpha: jax.Array             # f32 — blended-loss α (image_train.py:89)
+    scale: jax.Array             # f32 — model-replacement γ (image_train.py:166-171)
+    lr_row: jax.Array            # f32[E] — per-internal-epoch LR
+    num_epochs: jax.Array        # i32 — valid internal epochs (≤ E)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundHyper:
+    """Static (compile-time) round hyperparameters."""
+    momentum: float
+    weight_decay: float
+    poison_label_swap: int
+    lr: float                  # global lr — FoolsGold's apply step uses it
+    eta: float
+    no_models: int
+    aggregation: str           # cfg.AGGR_*
+    fg_use_memory: bool
+    diff_privacy: bool
+    sigma: float
+    geom_median_maxiter: int
+    max_update_norm: float | None = None
+
+    @classmethod
+    def from_params(cls, p: cfg.Params) -> "RoundHyper":
+        return cls(momentum=float(p["momentum"]),
+                   weight_decay=float(p["decay"]),
+                   poison_label_swap=int(p["poison_label_swap"]),
+                   lr=float(p["lr"]),
+                   eta=float(p["eta"]), no_models=int(p["no_models"]),
+                   aggregation=p.aggregation,
+                   fg_use_memory=bool(p["fg_use_memory"]),
+                   diff_privacy=bool(p["diff_privacy"]),
+                   sigma=float(p["sigma"]),
+                   geom_median_maxiter=int(p["geom_median_maxiter"]))
+
+
+def build_client_tasks(params: cfg.Params, agent_names: list, epoch: int,
+                       slots: np.ndarray, num_epochs_max: int,
+                       backdoor_acc: float | None = None) -> ClientTask:
+    """Host-side construction of the stacked ClientTask for one round.
+
+    Mirrors the reference's per-client setup: adversarial index resolution
+    (image_train.py:37-48), poison-epoch scheduling (:56), poison LR schedule
+    (:59-68), LOAN adaptive poison LR from the current global backdoor
+    accuracy (loan_train.py:67-75), scaling/baseline flags (:148,166).
+    """
+    from dba_mod_tpu.ops.sgd import poison_multistep_lr_array
+
+    C = len(agent_names)
+    is_loan = params.type == cfg.TYPE_LOAN
+    is_poison_run = bool(params["is_poison"])
+    baseline = bool(params["baseline"])
+    lr = float(params["lr"])
+    poison_lr = float(params["poison_lr"])
+    if is_loan and backdoor_acc is not None:
+        # loan_train.py:71-75
+        from dba_mod_tpu.ops.sgd import loan_adaptive_poison_lr
+        poison_lr = float(loan_adaptive_poison_lr(
+            poison_lr, np.float32(backdoor_acc), baseline))
+
+    E = num_epochs_max
+    internal_epochs = int(params["internal_epochs"])
+    internal_poison = int(params["internal_poison_epochs"])
+    step_lr_mult = (poison_multistep_lr_array(internal_poison,
+                                              step_before=is_loan)
+                    if bool(params["poison_step_lr"])
+                    else np.ones((internal_poison,), np.float32))
+
+    adv_idx = np.full((C,), -1, np.int32)
+    adv_slot = np.full((C,), -1, np.int32)
+    ppb = np.zeros((C,), np.int32)
+    alpha = np.ones((C,), np.float32)
+    scale = np.ones((C,), np.float32)
+    lr_rows = np.full((C, E), lr, np.float32)
+    n_epochs = np.full((C,), internal_epochs, np.int32)
+    pids = np.zeros((C,), np.int32)
+
+    for c, name in enumerate(agent_names):
+        if is_loan:
+            pids[c] = int(slots[c])
+        else:
+            pids[c] = int(name)
+        slot_of = params.adversary_slot_of(name)
+        adv_slot[c] = slot_of
+        poisoning_now = (is_poison_run and slot_of >= 0 and
+                         epoch in params.poison_epochs_for(slot_of))
+        if poisoning_now:
+            adv_idx[c] = params.adversarial_index_of(name)
+            ppb[c] = int(params["poisoning_per_batch"])
+            alpha[c] = float(params["alpha_loss"])
+            scale[c] = 1.0 if baseline else float(params["scale_weights_poison"])
+            n_epochs[c] = internal_poison
+            row = poison_lr * step_lr_mult
+            lr_rows[c, :] = 0.0
+            lr_rows[c, :min(E, internal_poison)] = row[:E]
+    return ClientTask(slot=slots.astype(np.int32), participant_id=pids,
+                      adv_index=adv_idx, adv_slot=adv_slot,
+                      poisoning_per_batch=ppb, alpha=alpha,
+                      scale=scale, lr_row=lr_rows, num_epochs=n_epochs)

@@ -1,0 +1,138 @@
+"""CLI — reference-compatible entry point.
+
+    python -m dba_mod_tpu.main --params configs/cifar_params.yaml
+
+mirrors `python main.py --params utils/cifar_params.yaml` (reference
+main.py:88-92); it also accepts the reference's own YAML files unchanged.
+Subcommands beyond the reference:
+
+    pretrain   train a clean model and save the checkpoint that attack
+               configs resume from (replaces the reference's Google-Drive
+               pretrained artifacts, README.md:33-34)
+    cache-tiny decode the Tiny-ImageNet image folders once into an .npz
+               cache for fast loading
+    loan-etl / tiny-etl   the reference's offline data prep
+               (utils/loan_preprocess.py, utils/tinyimagenet_reformat.py)
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from dba_mod_tpu.config import Params
+
+
+def _train(args) -> int:
+    from dba_mod_tpu.fl.experiment import Experiment
+    params = Params.from_yaml(args.params)
+    if args.epochs is not None:
+        params.raw["epochs"] = args.epochs
+    if args.synthetic:
+        params.raw["synthetic_data"] = True
+    exp = Experiment(params, save_results=not args.no_save)
+    last = exp.run()
+    if not last:  # resume checkpoint already at/after the final epoch
+        print(f"no rounds to run: start_epoch={exp.start_epoch} > "
+              f"epochs={params['epochs']}")
+        return 0
+    print(f"final: epoch={last.get('epoch')} "
+          f"acc={last.get('global_acc'):.2f} "
+          f"backdoor={last.get('backdoor_acc')}")
+    return 0
+
+
+def _pretrain(args) -> int:
+    from dba_mod_tpu import checkpoint as ckpt
+    from dba_mod_tpu.fl.experiment import Experiment
+    params = Params.from_yaml(args.params)
+    params.raw.update(is_poison=False, resumed_model=False,
+                      save_model=False)
+    if args.epochs is not None:
+        params.raw["epochs"] = args.epochs
+    if args.synthetic:
+        params.raw["synthetic_data"] = True
+    exp = Experiment(params, save_results=False)
+    last = exp.run()
+    out = Path("saved_models") / (
+        args.out or f"{params.type}_pretrain/model_last.pt.tar.epoch_"
+                    f"{params['epochs']}")
+    ckpt.save_checkpoint(out, exp.global_vars, int(params["epochs"]),
+                         float(params["lr"]))
+    acc = last.get("global_acc")
+    print(f"pretrained to epoch {params['epochs']} "
+          f"acc={acc if acc is None else round(acc, 2)} -> {out}")
+    return 0
+
+
+def _cache_tiny(args) -> int:
+    import numpy as np
+    from dba_mod_tpu.data.datasets import load_tiny_imagenet
+    data = load_tiny_imagenet(args.data_dir)
+    if data is None:
+        print("tiny-imagenet-200 folders not found (or PIL missing)",
+              file=sys.stderr)
+        return 1
+    out = Path(args.data_dir) / "tiny-imagenet-200.npz"
+    np.savez_compressed(out, train_x=data.train_images,
+                        train_y=data.train_labels, test_x=data.test_images,
+                        test_y=data.test_labels)
+    print(f"cached {len(data.train_labels)} train / "
+          f"{len(data.test_labels)} val images -> {out}")
+    return 0
+
+
+def _loan_etl(args) -> int:
+    from dba_mod_tpu.data.etl import preprocess_loan
+    n = preprocess_loan(args.input, Path(args.data_dir) / "loan")
+    print(f"wrote {n} per-state loan CSVs")
+    return 0
+
+
+def _tiny_etl(args) -> int:
+    from dba_mod_tpu.data.etl import reformat_tiny_imagenet_val
+    n = reformat_tiny_imagenet_val(Path(args.data_dir) / "tiny-imagenet-200")
+    print(f"moved {n} val images into per-class folders")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="dba_mod_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd")
+
+    def common(p):
+        p.add_argument("--params", required=True,
+                       help="YAML config (reference schema)")
+        p.add_argument("--epochs", type=int, default=None)
+        p.add_argument("--synthetic", action="store_true",
+                       help="force the synthetic dataset backend")
+
+    train = sub.add_parser("train", help="run an FL experiment (default)")
+    common(train)
+    train.add_argument("--no-save", action="store_true")
+    pre = sub.add_parser("pretrain", help="train+save a clean model")
+    common(pre)
+    pre.add_argument("--out", default=None,
+                     help="checkpoint path under saved_models/")
+    ct = sub.add_parser("cache-tiny")
+    ct.add_argument("--data-dir", default="./data")
+    le = sub.add_parser("loan-etl")
+    le.add_argument("--input", required=True, help="raw lending-club CSV")
+    le.add_argument("--data-dir", default="./data")
+    te = sub.add_parser("tiny-etl")
+    te.add_argument("--data-dir", default="./data")
+    return parser
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    known = {"train", "pretrain", "cache-tiny", "loan-etl", "tiny-etl"}
+    if argv and argv[0] not in known:
+        argv = ["train"] + argv  # reference style: --params only
+    args = build_parser().parse_args(argv)
+    return {"train": _train, "pretrain": _pretrain, "cache-tiny": _cache_tiny,
+            "loan-etl": _loan_etl, "tiny-etl": _tiny_etl}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

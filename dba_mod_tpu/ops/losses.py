@@ -45,12 +45,18 @@ def cross_entropy_sum(logits: jax.Array, labels: jax.Array,
 
 
 def tree_dist_norm(params: Any, target_params: Any):
-    """‖w - w_target‖₂ over a params pytree (helper.py:110-123)."""
+    """‖w - w_target‖₂ over a params pytree (helper.py:110-123).
+
+    Gradient-safe at zero distance: on a client's first step w == w_global, and
+    d√x/dx|₀ = ∞ would turn the blended loss's (1-α)·dist term into NaN via
+    0·∞ even at α=1. The double-where pattern keeps the gradient exactly 0
+    there."""
     sq = jax.tree_util.tree_reduce(
         lambda acc, leaves: acc + jnp.sum(jnp.square(leaves)),
         jax.tree_util.tree_map(lambda a, b: a - b, params, target_params),
         jnp.float32(0.0))
-    return jnp.sqrt(sq)
+    safe = jnp.where(sq > 0.0, sq, 1.0)
+    return jnp.where(sq > 0.0, jnp.sqrt(safe), 0.0)
 
 
 def tree_global_norm(params: Any):

@@ -1,0 +1,13 @@
+"""Parallelism layer: the `clients` device mesh and multi-host init.
+
+The reference's only "distribution" is a sequential Python loop over clients
+on one GPU (SURVEY §2.2). Here *clients are a mesh axis*: stacked per-client
+inputs are placed with a `clients` NamedSharding, the jitted round computation
+is partitioned by XLA across the mesh (each device trains its clients), and
+aggregation reductions lower to ICI collectives. Multi-host (DCN) scale uses
+the same program via `jax.distributed`.
+"""
+from dba_mod_tpu.parallel.mesh import (client_sharding, make_mesh,
+                                       replicated_sharding,
+                                       shard_round_inputs)
+from dba_mod_tpu.parallel.distributed import initialize_distributed

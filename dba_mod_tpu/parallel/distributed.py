@@ -1,0 +1,46 @@
+"""Multi-host (DCN) initialization.
+
+One FL round is a single SPMD program, so pod-scale runs need only
+`jax.distributed` process bootstrap: every host runs the same driver, the
+mesh spans all hosts' devices, per-host input shards are placed with
+`jax.make_array_from_process_local_data`, and XLA routes the aggregation
+collectives over ICI within a slice and DCN across slices. This is the
+TPU-native replacement for the NCCL/MPI backend slot the reference leaves
+empty (SURVEY §2.2 communication row).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("dba_mod_tpu")
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Initialize jax.distributed when running multi-host.
+
+    Explicit args win; otherwise standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) or cloud
+    auto-detection. Returns True when a multi-process runtime was set up.
+    No-op (False) for the common single-host case.
+    """
+    coordinator_address = (coordinator_address or
+                           os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator_address is None and num_processes is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=(num_processes if num_processes is not None else
+                       int(os.environ.get("JAX_NUM_PROCESSES", "0")) or None),
+        process_id=(process_id if process_id is not None else
+                    int(os.environ.get("JAX_PROCESS_ID", "-1"))
+                    if "JAX_PROCESS_ID" in os.environ else None))
+    logger.info("jax.distributed initialized: process %d/%d, %d local / %d "
+                "global devices", jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+    return jax.process_count() > 1

@@ -1,0 +1,59 @@
+"""The clients mesh and sharding specs.
+
+Design (SURVEY §2.2, §5 'distributed communication backend' row):
+- axis `clients`: stacked per-client state/batch tensors are sharded on their
+  leading axis; the vmapped client step then runs clients-per-device locally
+  with zero communication;
+- the global model is replicated; FedAvg's Σ_c Δ_c lowers to an ICI psum,
+  RFA's per-client distance vector to an all-gather of C scalars, and
+  FoolsGold's [C, L] feature-gradient matrix to an all-gather of the (small)
+  similarity layer — exactly the collective shapes sketched in SURVEY §5;
+- sharding is expressed as jit in_shardings (GSPMD), not hand-written
+  shard_map: XLA chooses the collective schedule.
+
+The round's client count must be a multiple of the mesh size; the experiment
+driver pads the stacked axis with inert clients (empty plans → zero deltas)
+under FedAvg, or picks a compatible no_models.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENTS_AXIS = "clients"
+
+
+def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
+    """1-D mesh over `num_devices` (0 = all visible) with a `clients` axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices:
+        devices = devices[:num_devices]
+    return Mesh(np.array(devices), (CLIENTS_AXIS,))
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over clients (pytree-prefix usable)."""
+    return NamedSharding(mesh, P(CLIENTS_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_round_inputs(mesh: Mesh, tasks: Any, idx, mask, num_samples):
+    """Place one round's stacked inputs with clients-axis sharding."""
+    cs = client_sharding(mesh)
+    put = lambda t: jax.device_put(t, cs)
+    return (jax.tree_util.tree_map(put, tasks), put(idx), put(mask),
+            put(num_samples))
+
+
+def pad_clients(n_clients: int, mesh: Optional[Mesh]) -> int:
+    """Smallest padded client count that tiles the mesh."""
+    if mesh is None:
+        return n_clients
+    d = mesh.devices.size
+    return int(-(-n_clients // d) * d)

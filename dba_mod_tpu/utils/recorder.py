@@ -1,0 +1,96 @@
+"""Result recording with column-schema parity to the reference's CSVs
+(utils/csv_record.py) so curves can be diffed directly, plus a JSONL metrics
+stream for modern tooling.
+
+Like the reference, `save()` rewrites every CSV each round (csv_record.py:21-59
+— crash-safe tail); unlike it, state lives on an instance, not module globals.
+"""
+from __future__ import annotations
+
+import csv
+import json
+import time
+from pathlib import Path
+from typing import Any, List, Optional
+
+TRAIN_HEADER = ["local_model", "round", "epoch", "internal_epoch",
+                "average_loss", "accuracy", "correct_data", "total_data"]
+TEST_HEADER = ["model", "epoch", "average_loss", "accuracy", "correct_data",
+               "total_data"]
+TRIGGER_HEADER = ["model", "trigger_name", "trigger_value", "epoch",
+                  "average_loss", "accuracy", "correct_data", "total_data"]
+
+
+class Recorder:
+    def __init__(self, folder: Optional[Path] = None):
+        self.folder = Path(folder) if folder else None
+        self.train_result: List[list] = []
+        self.test_result: List[list] = []
+        self.posiontest_result: List[list] = []   # (sic) reference file name
+        self.poisontriggertest_result: List[list] = []
+        self.weight_result: List[list] = []
+        self.scale_result: List[list] = []
+        self.scale_temp_one_row: List[Any] = []
+        self._jsonl_rows: List[dict] = []
+
+    # ------------------------------------------------------------------ adds
+    def add_train(self, name, temp_local_epoch, epoch, internal_epoch, loss,
+                  acc, correct, total):
+        self.train_result.append([name, temp_local_epoch, epoch,
+                                  internal_epoch, loss, acc, correct, total])
+
+    def add_test(self, name, epoch, loss, acc, correct, total):
+        self.test_result.append([name, epoch, loss, acc, correct, total])
+
+    def add_poisontest(self, name, epoch, loss, acc, correct, total):
+        self.posiontest_result.append([name, epoch, loss, acc, correct,
+                                       total])
+
+    def add_triggertest(self, model, trigger_name, trigger_value, epoch, loss,
+                        acc, correct, total):
+        self.poisontriggertest_result.append(
+            [model, trigger_name, trigger_value, epoch, loss, acc, correct,
+             total])
+
+    def add_weight_result(self, names, weights, alphas):
+        # reference appends three rows per round (csv_record.py:61-64)
+        self.weight_result.append(list(names))
+        self.weight_result.append(list(weights))
+        self.weight_result.append(list(alphas))
+
+    def add_round_json(self, **kwargs):
+        kwargs.setdefault("time", time.time())
+        self._jsonl_rows.append(kwargs)
+
+    # ------------------------------------------------------------------ save
+    def save(self, is_poison: bool):
+        # the scale row closes at save time whether or not files are written
+        # (csv_record.py:44-50 semantics)
+        if self.scale_temp_one_row:
+            self.scale_result.append(list(self.scale_temp_one_row))
+            self.scale_temp_one_row.clear()
+        if self.folder is None:
+            return
+        self.folder.mkdir(parents=True, exist_ok=True)
+
+        def write(name, header, rows):
+            with open(self.folder / name, "w", newline="") as f:
+                w = csv.writer(f)
+                if header:
+                    w.writerow(header)
+                w.writerows(rows)
+
+        write("train_result.csv", TRAIN_HEADER, self.train_result)
+        write("test_result.csv", TEST_HEADER, self.test_result)
+        if self.weight_result:
+            write("weight_result.csv", None, self.weight_result)
+        if self.scale_result:
+            write("scale_result.csv", None, self.scale_result)
+        if is_poison:
+            write("posiontest_result.csv", TEST_HEADER,
+                  self.posiontest_result)
+            write("poisontriggertest_result.csv", TRIGGER_HEADER,
+                  self.poisontriggertest_result)
+        with open(self.folder / "metrics.jsonl", "w") as f:
+            for row in self._jsonl_rows:
+                f.write(json.dumps(row) + "\n")

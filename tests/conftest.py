@@ -1,12 +1,28 @@
 """Test configuration: force an 8-device virtual CPU platform.
 
 Multi-device tests exercise the `clients` mesh axis without TPU hardware — the
-TPU-world equivalent of a fake backend (SURVEY.md §4). Must run before jax
-initializes a backend, hence module-level in conftest.
+TPU-world equivalent of a fake backend (SURVEY.md §4).
+
+Note: this image's sitecustomize registers an `axon` TPU PJRT plugin at
+interpreter startup and pins the platform, so setting JAX_PLATFORMS in the
+environment is not enough — we must override the jax config after import and
+set the host-device-count flag before the CPU backend initializes.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: the suite's cost is XLA compiles of model-sized
+# programs; cache them across runs (safe to delete anytime).
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_dba_tests")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}")

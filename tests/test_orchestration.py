@@ -1,0 +1,172 @@
+"""Unit tests: agent selection modes, recorder CSV output, checkpoint
+roundtrip, ETL, DP noise, CLI parser."""
+import csv
+import random
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.fl.selection import select_agents
+from dba_mod_tpu.utils.recorder import Recorder
+
+
+def _params(**extra):
+    d = dict(type="mnist", lr=0.1, batch_size=64, epochs=10, no_models=5,
+             number_of_total_participants=20, eta=0.1,
+             aggregation_methods="mean", adversary_list=[3, 7],
+             is_poison=True, trigger_num=2,
+             **{"0_poison_epochs": [4, 5], "1_poison_epochs": [5]})
+    d.update(extra)
+    return cfg.Params.from_dict(d)
+
+
+PARTICIPANTS = list(range(20))
+BENIGN = [p for p in PARTICIPANTS if p not in (3, 7)]
+
+
+class TestSelection:
+    def test_forced_adversaries_in_poison_epoch(self):
+        # main.py:147-161: scheduled adversaries forced in, benign fill
+        p = _params()
+        rng = random.Random(0)
+        agents, advs = select_agents(p, 5, PARTICIPANTS, BENIGN, rng)
+        assert agents[:2] == [3, 7] and advs == [3, 7]
+        assert len(agents) == 5 and len(set(agents)) == 5
+
+    def test_offschedule_adversaries_can_fill_benign_slots(self):
+        p = _params()
+        rng = random.Random(0)
+        agents, advs = select_agents(p, 1, PARTICIPANTS, BENIGN, rng)
+        assert advs == []
+        assert len(agents) == 5
+
+    def test_random_adversary_mode(self):
+        # main.py:142-146: pure uniform sample; adversaries only by chance
+        p = _params(is_random_adversary=True)
+        rng = random.Random(1)
+        agents, advs = select_agents(p, 4, PARTICIPANTS, BENIGN, rng)
+        assert len(agents) == 5
+        assert set(advs) == set(agents) & {3, 7}
+
+    def test_fixed_namelist_mode(self):
+        p = _params(is_random_namelist=False,
+                    participants_namelist=[1, 2, 3])
+        agents, advs = select_agents(p, 4, [1, 2, 3], BENIGN,
+                                     random.Random(0))
+        assert agents == [1, 2, 3]
+        assert advs == [3, 7]
+
+
+class TestRecorder:
+    def test_csv_files_and_schemas(self, tmp_path):
+        rec = Recorder(tmp_path)
+        rec.add_train(0, 1, 1, 1, 0.5, 90.0, 450, 500)
+        rec.add_test("global", 1, 0.4, 91.0, 9100, 10000)
+        rec.add_poisontest("global", 1, 1.2, 55.0, 4950, 9000)
+        rec.add_triggertest("global", "combine", "", 1, 1.2, 55.0, 4950, 9000)
+        rec.add_weight_result([0, 1], [0.5, 0.5], [0.1, 0.2])
+        rec.scale_temp_one_row.extend([1, 6.4])
+        rec.add_round_json(epoch=1, global_acc=91.0)
+        rec.save(is_poison=True)
+        names = {p.name for p in tmp_path.iterdir()}
+        assert {"train_result.csv", "test_result.csv",
+                "posiontest_result.csv", "poisontriggertest_result.csv",
+                "weight_result.csv", "scale_result.csv",
+                "metrics.jsonl"} <= names
+        with open(tmp_path / "train_result.csv") as f:
+            rows = list(csv.reader(f))
+        assert rows[0] == ["local_model", "round", "epoch", "internal_epoch",
+                           "average_loss", "accuracy", "correct_data",
+                           "total_data"]
+        assert rows[1][0] == "0"
+        # rewrite-every-round: saving again must not duplicate
+        rec.save(is_poison=True)
+        with open(tmp_path / "train_result.csv") as f:
+            assert len(list(csv.reader(f))) == 2
+
+    def test_scale_row_closes_without_folder(self):
+        rec = Recorder(None)
+        rec.scale_temp_one_row.extend([3, 1.5])
+        rec.save(is_poison=True)
+        assert rec.scale_result == [[3, 1.5]]
+        assert rec.scale_temp_one_row == []
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from dba_mod_tpu import checkpoint as ckpt
+    from dba_mod_tpu.models import build_model
+    p = _params()
+    md = build_model(p)
+    mv = md.init_vars(jax.random.key(0))
+    ckpt.save_checkpoint(tmp_path / "m", mv, epoch=7, lr=0.05)
+    like = md.init_vars(jax.random.key(1))
+    restored, epoch, lr = ckpt.load_checkpoint(tmp_path / "m", like)
+    assert epoch == 7 and lr == 0.05
+    a = jax.tree_util.tree_leaves(mv.params)[0]
+    b = jax.tree_util.tree_leaves(restored.params)[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loan_etl(tmp_path):
+    import pandas as pd
+    from dba_mod_tpu.data.etl import preprocess_loan
+    rng = np.random.RandomState(0)
+    n = 60
+    df = pd.DataFrame({
+        "id": np.arange(n),                      # dropped
+        "loan_status": rng.randint(0, 9, n),
+        "grade": rng.choice(["A", "B", "C"], n),  # object → ordinal
+        "big_num": rng.uniform(5000, 20000, n),   # mean>1000 → /10000
+        "mid_num": rng.uniform(15, 40, n),        # mean in (10,100] → /10
+        "addr_state": rng.choice(["CA", "NY", "TX"], n),
+    })
+    src = tmp_path / "loan.csv"
+    df.to_csv(src, index=False)
+    count = preprocess_loan(src, tmp_path / "loan")
+    assert count == 3
+    out = pd.read_csv(tmp_path / "loan" / "loan_CA.csv")
+    assert "id" not in out.columns and "addr_state" not in out.columns
+    assert out["big_num"].mean() < 10  # magnitude-bucketed
+    assert set(out["grade"].unique()) <= {0, 1, 2}
+
+
+def test_tiny_etl(tmp_path):
+    from dba_mod_tpu.data.etl import reformat_tiny_imagenet_val
+    val = tmp_path / "val"
+    (val / "images").mkdir(parents=True)
+    for i, wnid in enumerate(["n01", "n01", "n02"]):
+        (val / "images" / f"val_{i}.JPEG").write_bytes(b"x")
+    with open(val / "val_annotations.txt", "w") as f:
+        f.write("val_0.JPEG\tn01\t0\t0\t10\t10\n"
+                "val_1.JPEG\tn01\t0\t0\t10\t10\n"
+                "val_2.JPEG\tn02\t0\t0\t10\t10\n")
+    moved = reformat_tiny_imagenet_val(tmp_path)
+    assert moved == 3
+    assert (val / "n01" / "val_0.JPEG").exists()
+    assert (val / "n02" / "val_2.JPEG").exists()
+    assert not (val / "val_annotations.txt").exists()
+
+
+def test_dp_noise_applied_in_fedavg():
+    from dba_mod_tpu.ops import aggregation as agg
+    g = {"w": jnp.zeros((50, 50))}
+    deltas = {"w": jnp.zeros((4, 50, 50))}
+    out_plain = agg.fedavg_update(g, deltas, 0.1, 4)
+    out_noised = agg.fedavg_update(g, deltas, 0.1, 4, dp_sigma=0.01,
+                                   rng=jax.random.key(0))
+    assert float(jnp.abs(out_plain["w"]).sum()) == 0.0
+    noise = np.asarray(out_noised["w"])
+    assert noise.std() == pytest.approx(0.01, rel=0.2)
+
+
+def test_cli_parser_reference_style():
+    from dba_mod_tpu.main import build_parser, main
+    # reference style gets rewritten to the train subcommand
+    args = build_parser().parse_args(
+        ["train", "--params", "configs/smoke_params.yaml"])
+    assert args.cmd == "train" and args.params.endswith("smoke_params.yaml")

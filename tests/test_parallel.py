@@ -1,0 +1,55 @@
+"""Multi-device tests on the virtual 8-device CPU mesh (conftest.py) — the
+clients axis sharded over devices must reproduce single-device numerics."""
+import numpy as np
+import pytest
+
+import jax
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.experiment import Experiment
+
+BASE = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=4, no_models=8,
+    number_of_total_participants=16, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, internal_poison_epochs=2, is_poison=True,
+    synthetic_data=True, synthetic_train_size=640, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False,
+    poison_label_swap=2, poisoning_per_batch=8, poison_lr=0.05,
+    scale_weights_poison=3.0, adversary_list=[0], trigger_num=1,
+    alpha_loss=1.0, random_seed=1,
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2], [0, 3]],
+       "0_poison_epochs": [2, 3]})
+
+
+def test_mesh_matches_single_device():
+    assert jax.device_count() >= 8, "conftest must provide 8 CPU devices"
+    e1 = Experiment(Params.from_dict(BASE), save_results=False)
+    e8 = Experiment(Params.from_dict(dict(BASE, num_devices=8)),
+                    save_results=False)
+    assert e8.mesh is not None and e8.mesh.devices.size == 8
+    for i in range(1, 4):
+        r1 = e1.run_round(i)
+        r8 = e8.run_round(i)
+    # identical seeds → identical rounds up to reduction-order noise
+    assert abs(r1["global_acc"] - r8["global_acc"]) < 1.0
+    assert abs(r1["backdoor_acc"] - r8["backdoor_acc"]) < 2.0
+    l1 = jax.tree_util.tree_leaves(e1.global_vars.params)[0]
+    l8 = jax.tree_util.tree_leaves(e8.global_vars.params)[0]
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l8), atol=5e-3)
+
+
+def test_mesh_pads_nondividing_client_count():
+    cfg = dict(BASE, no_models=6, num_devices=8)
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    r = e.run_round(1)
+    assert np.isfinite(r["global_acc"])
+    # only the 6 real clients are recorded
+    assert len({row[0] for row in e.recorder.train_result}) == 6
+
+
+def test_mesh_padding_rejected_for_defenses():
+    cfg = dict(BASE, no_models=6, num_devices=8,
+               aggregation_methods="geom_median")
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    with pytest.raises(ValueError, match="tile"):
+        e.run_round(1)
