@@ -30,7 +30,11 @@ BENCH_CONFIG = dict(
     internal_epochs=2, momentum=0.9, decay=0.0005, is_poison=False,
     synthetic_data=True,  # zero-egress image: CIFAR-shaped synthetic data
     sampling_dirichlet=True, dirichlet_alpha=0.5, local_eval=True,
-    random_seed=1)
+    random_seed=1,
+    # TPU-native settings: bf16 MXU compute (f32 params/aggregation —
+    # backdoor efficacy validated in tests/test_fl_integration.py), fat eval
+    # batches (eval sums are batch-size invariant)
+    compute_dtype="bfloat16", eval_batch_size=512)
 
 
 def measure_ours(timed_rounds: int) -> float:

@@ -73,6 +73,14 @@ _DEFAULTS: Dict[str, Any] = {
     "results_json": True,
     "random_seed": 1,
     # framework-specific knobs (not in the reference schema)
+    "compute_dtype": "float32",    # "bfloat16" runs fwd/bwd on the MXU in
+                                   # bf16; params/optimizer/aggregation stay
+                                   # float32
+    "eval_batch_size": 0,          # 0 = use test_batch_size
+    "local_eval": True,            # per-client eval battery (reference
+                                   # image_train.py:150-164, 268-299)
+    "profile_dir": "",             # non-empty: jax.profiler traces per round
+    "tensorboard": False,          # scalar summaries (imports TensorFlow)
     "data_dir": "./data",
     "synthetic_data": False,       # force the synthetic dataset backend
     "synthetic_train_size": 0,     # 0 = backend default

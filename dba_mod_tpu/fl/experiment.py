@@ -30,7 +30,7 @@ from dba_mod_tpu.fl.device_data import (make_image_device_data,
 from dba_mod_tpu.fl.rounds import EvalPlans, RoundEngine
 from dba_mod_tpu.fl.selection import select_agents
 from dba_mod_tpu.fl.state import build_client_tasks
-from dba_mod_tpu.models import ModelVars, build_model
+from dba_mod_tpu.models import ModelVars, build_model, compute_dtype_of
 from dba_mod_tpu.ops.aggregation import foolsgold_init
 from dba_mod_tpu.utils.recorder import Recorder
 
@@ -62,7 +62,12 @@ class Experiment:
         if self.folder and not logger.handlers:
             logging.basicConfig(level=logging.INFO)
             logger.addHandler(logging.FileHandler(self.folder / "log.txt"))
-        self.recorder = Recorder(self.folder)
+        if self.folder:
+            from dba_mod_tpu.utils.html import dict_html
+            (self.folder / "params.html").write_text(
+                dict_html(params.raw, params.current_time))
+        self.recorder = Recorder(self.folder,
+                                 tensorboard=bool(params.get("tensorboard")))
         self.model_def = build_model(params)
         seed = int(params.get("random_seed", 1))
         self.select_rng = random.Random(seed)
@@ -112,9 +117,15 @@ class Experiment:
     # ------------------------------------------------------------------ data
     def _load_data_and_partition(self, seed: int):
         params = self.params
+        cdtype = compute_dtype_of(params)
+        # eval batch size only shapes the eval scans; the recorded sums are
+        # batch-size invariant (test.py:21-22's reduction='sum')
+        eb = int(params.get("eval_batch_size", 0) or
+                 params["test_batch_size"])
         if params.is_image:
             data = self.image_data = load_image_dataset(params)
-            self.device_data = make_image_device_data(data, params)
+            self.device_data = make_image_device_data(data, params,
+                                                      compute_dtype=cdtype)
             if params["sampling_dirichlet"]:
                 indices = sample_dirichlet_indices(
                     data.train_labels,
@@ -139,12 +150,10 @@ class Experiment:
             self.num_participants = int(
                 params["number_of_total_participants"])
 
-            clean = build_eval_plan(np.arange(len(data.test_labels)),
-                                    int(params["batch_size"]))
+            clean = build_eval_plan(np.arange(len(data.test_labels)), eb)
             poison = build_eval_plan(
                 poison_test_indices(data.test_labels,
-                                    int(params["poison_label_swap"])),
-                int(params["batch_size"]))
+                                    int(params["poison_label_swap"])), eb)
             self.eval_plans = EvalPlans(
                 clean_idx=jnp.asarray(clean.idx),
                 clean_slots=jnp.zeros_like(jnp.asarray(clean.idx)),
@@ -154,7 +163,8 @@ class Experiment:
                 poison_mask=jnp.asarray(poison.mask))
         else:
             data = self.loan_data = load_loan_dataset(params)
-            self.device_data = make_loan_device_data(data, params)
+            self.device_data = make_loan_device_data(data, params,
+                                                     compute_dtype=cdtype)
             state_of = {n: i for i, n in enumerate(data.state_names)}
             # benign list: first `number_of_total_participants` shards that
             # are not adversaries (loan_helper.py:134-141)
@@ -176,7 +186,7 @@ class Experiment:
             self.num_participants = len(data.state_names)
 
             # eval plans concatenate every state shard (test.py:13-24)
-            b = int(params["batch_size"])
+            b = eb
             pairs = [(s, i) for s, ys in enumerate(data.test_y)
                      for i in range(len(ys))]
             slots = np.array([p[0] for p in pairs], np.int64)
@@ -252,19 +262,16 @@ class Experiment:
             self.global_vars, self.fg_state, tasks_dev,
             idx_dev, mask_dev, ns_dev, round_key)
 
-        locals_ = None
-        if self.local_eval:
-            locals_ = jax.device_get(self.engine.local_evals_fn(
-                self.global_vars, result.deltas, tasks_dev))
-
+        # dispatch every eval before any host sync — one blocking transfer
+        locals_dev = (self.engine.local_evals_fn(
+            self.global_vars, result.deltas, tasks_dev)
+            if self.local_eval else None)
+        globals_dev = self.engine.global_evals_fn(result.new_vars)
         self.global_vars = result.new_vars
         self.fg_state = result.new_fg_state
-        globals_ = jax.device_get(self.engine.global_evals_fn(
-            self.global_vars))
-        metrics = jax.device_get(result.metrics)
-        delta_norms = np.asarray(result.delta_norms)
-        wv = np.asarray(result.wv)
-        alpha = np.asarray(result.alpha)
+        locals_, globals_, metrics, delta_norms, wv, alpha = jax.device_get(
+            (locals_dev, globals_dev, result.metrics, result.delta_norms,
+             result.wv, result.alpha))
 
         self._record(epoch, agent_names, adv_names, tasks, metrics, locals_,
                      globals_, delta_norms, wv, alpha, t0)
@@ -383,8 +390,14 @@ class Experiment:
             raise NotImplementedError(
                 "aggr_epoch_interval != 1 is not supported yet (all reference "
                 "configs use 1; see utils/*_params.yaml)")
+        profile_dir = str(self.params.get("profile_dir", "") or "")
         for epoch in range(self.start_epoch, end + 1, interval):
-            last = self.run_round(epoch)
+            if profile_dir and epoch == self.start_epoch + 1:
+                # trace the first post-compile round (SURVEY §5 tracing row)
+                with jax.profiler.trace(profile_dir):
+                    last = self.run_round(epoch)
+            else:
+                last = self.run_round(epoch)
             self.save_model(epoch)
             logger.info("epoch %d done in %.2fs acc=%.2f backdoor=%s",
                         epoch, last["round_time"], last["global_acc"],

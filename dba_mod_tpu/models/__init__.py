@@ -79,25 +79,37 @@ class ModelDef:
         return p
 
 
+def compute_dtype_of(params: cfg.Params):
+    name = str(params.get("compute_dtype", "float32"))
+    if name in ("float32", "f32"):
+        return jnp.float32
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    raise ValueError(f"unknown compute_dtype {name!r}")
+
+
 def build_model(params: cfg.Params) -> ModelDef:
     t = params.type
+    dtype = compute_dtype_of(params)
     if t == cfg.TYPE_MNIST:
-        return ModelDef(name="MnistNet", module=MnistNet(),
+        return ModelDef(name="MnistNet", module=MnistNet(dtype=dtype),
                         input_shape=(28, 28, 1), num_classes=10,
                         similarity_path=("Dense_1", "kernel"),
                         has_batch_stats=False, has_dropout=False)
     if t == cfg.TYPE_CIFAR:
-        return ModelDef(name="CifarResNet18", module=cifar_resnet18(),
+        return ModelDef(name="CifarResNet18",
+                        module=cifar_resnet18(dtype=dtype),
                         input_shape=(32, 32, 3), num_classes=10,
                         similarity_path=("Dense_0", "kernel"),
                         has_batch_stats=True, has_dropout=False)
     if t == cfg.TYPE_TINYIMAGENET:
-        return ModelDef(name="TinyResNet18", module=tiny_resnet18(),
+        return ModelDef(name="TinyResNet18",
+                        module=tiny_resnet18(dtype=dtype),
                         input_shape=(64, 64, 3), num_classes=200,
                         similarity_path=("Dense_0", "kernel"),
                         has_batch_stats=True, has_dropout=False)
     if t == cfg.TYPE_LOAN:
-        return ModelDef(name="LoanNet", module=LoanNet(),
+        return ModelDef(name="LoanNet", module=LoanNet(dtype=dtype),
                         input_shape=(91,), num_classes=9,
                         similarity_path=("Dense_2", "kernel"),
                         has_batch_stats=False, has_dropout=True)

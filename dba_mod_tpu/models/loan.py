@@ -8,7 +8,10 @@ a data-dependent Python raise can't live inside a jitted forward.
 """
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 from dba_mod_tpu.ops.initializers import torch_bias_init, torch_kaiming_uniform
 
@@ -19,17 +22,22 @@ class LoanNet(nn.Module):
     hidden2: int = 23
     num_classes: int = 9
     dropout_rate: float = 0.5
+    dtype: Any = jnp.float32  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Dense(self.hidden1, kernel_init=torch_kaiming_uniform,
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.hidden1, dtype=self.dtype,
+                     kernel_init=torch_kaiming_uniform,
                      bias_init=torch_bias_init(self.in_dim))(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.relu(x)
-        x = nn.Dense(self.hidden2, kernel_init=torch_kaiming_uniform,
+        x = nn.Dense(self.hidden2, dtype=self.dtype,
+                     kernel_init=torch_kaiming_uniform,
                      bias_init=torch_bias_init(self.hidden1))(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.relu(x)
-        x = nn.Dense(self.num_classes, kernel_init=torch_kaiming_uniform,
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=torch_kaiming_uniform,
                      bias_init=torch_bias_init(self.hidden2))(x)
-        return x
+        return x.astype(jnp.float32)

@@ -12,6 +12,8 @@ equals training on logits — we keep the log_softmax head for output parity.
 """
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -20,24 +22,29 @@ from dba_mod_tpu.ops.initializers import torch_bias_init, torch_kaiming_uniform
 
 class MnistNet(nn.Module):
     num_classes: int = 10
+    dtype: Any = jnp.float32  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         # x: [N, 28, 28, 1]
-        x = nn.Conv(20, (5, 5), padding="VALID",
+        x = x.astype(self.dtype)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype,
                     kernel_init=torch_kaiming_uniform,
                     bias_init=torch_bias_init(1 * 5 * 5))(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(50, (5, 5), padding="VALID",
+        x = nn.Conv(50, (5, 5), padding="VALID", dtype=self.dtype,
                     kernel_init=torch_kaiming_uniform,
                     bias_init=torch_bias_init(20 * 5 * 5))(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))  # [N, 4*4*50]
-        x = nn.Dense(500, kernel_init=torch_kaiming_uniform,
+        x = nn.Dense(500, dtype=self.dtype,
+                     kernel_init=torch_kaiming_uniform,
                      bias_init=torch_bias_init(800))(x)
         x = nn.relu(x)
-        x = nn.Dense(self.num_classes, kernel_init=torch_kaiming_uniform,
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=torch_kaiming_uniform,
                      bias_init=torch_bias_init(500))(x)
-        return nn.log_softmax(x, axis=-1)
+        # head in float32 — log_softmax over bf16 logits costs accuracy
+        return nn.log_softmax(x.astype(jnp.float32), axis=-1)

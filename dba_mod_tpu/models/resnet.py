@@ -93,12 +93,15 @@ class ResNet(nn.Module):
     pool: str = "avg4"                           # "avg4": 4x4 window; "global"
     kernel_init: Callable = torch_kaiming_uniform
     head_init: Tuple[Callable, Callable] | None = None  # (kernel_init, bias_init)
+    dtype: Any = jnp.float32   # compute dtype; params/batch_stats stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        conv = partial(nn.Conv, kernel_init=self.kernel_init)
+        x = x.astype(self.dtype)
+        conv = partial(nn.Conv, kernel_init=self.kernel_init,
+                       dtype=self.dtype)
         norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5)
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         block_cls = Bottleneck if self.bottleneck else BasicBlock
 
         if self.stem == "cifar":
@@ -128,27 +131,42 @@ class ResNet(nn.Module):
         feat = x.shape[-1]
         k_init, b_init = (self.head_init if self.head_init is not None
                           else (torch_kaiming_uniform, torch_bias_init(feat)))
-        x = nn.Dense(self.num_classes, kernel_init=k_init, bias_init=b_init)(x)
-        return x
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=k_init, bias_init=b_init)(x)
+        return x.astype(jnp.float32)
 
 
-def cifar_resnet18(num_classes: int = 10) -> ResNet:
+def cifar_resnet18(num_classes: int = 10, *, dtype=jnp.float32) -> ResNet:
     return ResNet(num_classes=num_classes, num_blocks=(2, 2, 2, 2),
-                  widths=(32, 64, 128, 256), stem="cifar", pool="avg4")
+                  widths=(32, 64, 128, 256), stem="cifar", pool="avg4",
+                  dtype=dtype)
 
 
-def cifar_resnet34(num_classes: int = 10) -> ResNet:
+def cifar_resnet34(num_classes: int = 10, *, dtype=jnp.float32) -> ResNet:
     return ResNet(num_classes=num_classes, num_blocks=(3, 4, 6, 3),
-                  widths=(32, 64, 128, 256), stem="cifar", pool="avg4")
+                  widths=(32, 64, 128, 256), stem="cifar", pool="avg4",
+                  dtype=dtype)
 
 
-def cifar_resnet50(num_classes: int = 10) -> ResNet:
+def cifar_resnet50(num_classes: int = 10, *, dtype=jnp.float32) -> ResNet:
     return ResNet(num_classes=num_classes, num_blocks=(3, 4, 6, 3),
                   widths=(32, 64, 128, 256), bottleneck=True,
-                  stem="cifar", pool="avg4")
+                  stem="cifar", pool="avg4", dtype=dtype)
 
 
-def tiny_resnet18(num_classes: int = 200) -> ResNet:
+def tiny_resnet18(num_classes: int = 200, *, dtype=jnp.float32) -> ResNet:
     return ResNet(num_classes=num_classes, num_blocks=(2, 2, 2, 2),
                   widths=(64, 128, 256, 512), stem="imagenet", pool="global",
-                  kernel_init=kaiming_normal_fan_out)
+                  kernel_init=kaiming_normal_fan_out, dtype=dtype)
+
+
+def cifar_resnet101(num_classes: int = 10, *, dtype=jnp.float32) -> ResNet:
+    return ResNet(num_classes=num_classes, num_blocks=(3, 4, 23, 3),
+                  widths=(32, 64, 128, 256), bottleneck=True,
+                  stem="cifar", pool="avg4", dtype=dtype)
+
+
+def cifar_resnet152(num_classes: int = 10, *, dtype=jnp.float32) -> ResNet:
+    return ResNet(num_classes=num_classes, num_blocks=(3, 8, 36, 3),
+                  widths=(32, 64, 128, 256), bottleneck=True,
+                  stem="cifar", pool="avg4", dtype=dtype)

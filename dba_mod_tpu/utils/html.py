@@ -1,0 +1,20 @@
+"""Params → HTML table (reference utils/utils.py:8-19 `dict_html`).
+
+The reference posts this into the visdom dashboard header (main.py:122);
+here it is written into the run folder as `params.html` so a run's exact
+configuration is one click away without a plot server.
+"""
+from __future__ import annotations
+
+import html
+from typing import Any, Dict
+
+
+def dict_html(d: Dict[str, Any], current_time: str = "") -> str:
+    rows = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>"
+        for k, v in sorted(d.items(), key=lambda kv: str(kv[0])))
+    return (f"<h4>Run {html.escape(str(current_time))}</h4>"
+            f"<table border=1 cellpadding=2>"
+            f"<tr><th>param</th><th>value</th></tr>{rows}</table>")

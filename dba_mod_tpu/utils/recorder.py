@@ -22,8 +22,15 @@ TRIGGER_HEADER = ["model", "trigger_name", "trigger_value", "epoch",
 
 
 class Recorder:
-    def __init__(self, folder: Optional[Path] = None):
+    def __init__(self, folder: Optional[Path] = None,
+                 tensorboard: bool = False):
+        """`tensorboard` is opt-in (config key of the same name): the writer
+        drags the TensorFlow import into the process."""
         self.folder = Path(folder) if folder else None
+        self._tb = None
+        if self.folder is not None and tensorboard:
+            from flax.metrics.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(str(self.folder / "tb"))
         self.train_result: List[list] = []
         self.test_result: List[list] = []
         self.posiontest_result: List[list] = []   # (sic) reference file name
@@ -61,6 +68,12 @@ class Recorder:
     def add_round_json(self, **kwargs):
         kwargs.setdefault("time", time.time())
         self._jsonl_rows.append(kwargs)
+        if self._tb is not None and "epoch" in kwargs:
+            step = int(kwargs["epoch"])
+            for k, v in kwargs.items():
+                if isinstance(v, (int, float)) and k not in ("epoch", "time"):
+                    self._tb.scalar(k, float(v), step)
+            self._tb.flush()
 
     # ------------------------------------------------------------------ save
     def save(self, is_poison: bool):

@@ -108,3 +108,19 @@ def test_loan_workload_end_to_end():
     assert out[4]["backdoor_acc"] is not None
     # natural non-IID: clients are state shards
     assert e.num_participants >= 8
+
+
+def test_bf16_compute_path():
+    """bfloat16 fwd/bwd (MXU path) with float32 params/aggregation must still
+    learn and plant the backdoor."""
+    e = Experiment(Params.from_dict(dict(POISON, compute_dtype="bfloat16")),
+                   save_results=False)
+    for i in range(1, 7):
+        r = e.run_round(i)
+        assert np.isfinite(r["global_acc"])
+    assert r["backdoor_acc"] > 80.0
+    import jax.numpy as jnp
+    import jax
+    # params stayed f32
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(e.global_vars.params))
