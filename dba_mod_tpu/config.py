@@ -81,6 +81,7 @@ _DEFAULTS: Dict[str, Any] = {
                                    # image_train.py:150-164, 268-299)
     "profile_dir": "",             # non-empty: jax.profiler traces per round
     "tensorboard": False,          # scalar summaries (imports TensorFlow)
+    "sequential_debug": False,     # run clients one-by-one (A/B vs vmapped)
     "data_dir": "./data",
     "synthetic_data": False,       # force the synthetic dataset backend
     "synthetic_train_size": 0,     # 0 = backend default

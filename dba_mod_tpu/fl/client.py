@@ -5,18 +5,25 @@ Capability parity with the reference client loop (image_train.py:21-315,
 loan_train.py:17-261), re-expressed as data-dependent selects so benign and
 poison clients share one compiled program:
 
-- fresh torch-SGD per round (momentum buffers start at zero — the reference
-  constructs a new optimizer per client per round, image_train.py:33, :63);
+- fresh torch-SGD per global epoch (momentum buffers start at zero — the
+  reference constructs a new optimizer per client per round,
+  image_train.py:33, :63);
 - per-internal-epoch LR row (benign constant lr; poison MultiStepLR —
   image_train.py:66-68, 118-119);
-- loss = α·CE + (1-α)·‖w - w_global‖ (image_train.py:85-90);
+- loss = α·CE + (1-α)·‖w - w_anchor‖ (image_train.py:85-90);
 - batch poisoning of the first `poisoning_per_batch` samples
   (image_helper.py:298-326);
 - FoolsGold per-parameter gradient accumulation across every batch
   (image_train.py:94-100);
-- model-replacement scaling epilogue w ← w_g + γ·(w - w_g) over the FULL
-  state including BN stats (image_train.py:166-171 scales the state_dict);
-- emits Δ = w_final - w_global over the full state (image_train.py:301-311).
+- model-replacement scaling epilogue w ← w_a + γ·(w - w_a) over the FULL
+  state including BN stats (image_train.py:166-171 scales the state_dict).
+
+One call covers ONE global epoch (one `aggr_epoch_interval` segment). The
+anchor for the distance loss and the scaling epilogue is the client's state at
+the segment start — the reference re-snapshots `last_local_model` at the top
+of every global epoch (image_train.py:26-27, :52-54, :306), which equals the
+global model only for the first segment of a round. The engine chains
+segments and derives Δ = w_end - w_global at the end.
 
 Per-epoch train metrics (loss sum, correct, count, poisoned count) are
 accumulated with scatter-adds for CSV-schema parity (csv_record.train_result).
@@ -42,10 +49,10 @@ class ClientMetrics(NamedTuple):
     poison_count: jax.Array  # [E] poisoned samples seen
 
 
-class ClientResult(NamedTuple):
-    delta: ModelVars         # w_final - w_global (post-scaling), full state
-    fg_grads: Any            # accumulated grads (params tree) or zeros
-    fg_feature: jax.Array    # [L] flattened similarity-layer grad
+class SegmentResult(NamedTuple):
+    end_vars: ModelVars      # post-scaling client state (next segment's start)
+    benign_mom: Any          # benign-optimizer momentum after this segment
+    fg_grads: Any            # grads accumulated THIS segment (params tree)
     metrics: ClientMetrics
 
 
@@ -56,14 +63,21 @@ def _select_tree(pred, new, old):
 
 def make_client_step(model_def: ModelDef, data: DeviceData,
                      hyper: RoundHyper, fg_enabled: bool):
-    """Returns client_step(global_vars, task_row, idx[E,S,B], mask[E,S,B],
-    rng) -> ClientResult, suitable for vmap over (task_row, idx, mask, rng)."""
+    """Returns client_step(start_vars, task_row, idx[E,S,B], mask[E,S,B],
+    rng) -> SegmentResult, suitable for vmap over (start_vars, task_row, idx,
+    mask, rng)."""
 
-    def client_step(global_vars: ModelVars, task: ClientTask, idx, mask,
-                    rng) -> ClientResult:
+    def client_step(start_vars: ModelVars, benign_mom: Any, task: ClientTask,
+                    idx, mask, rng) -> SegmentResult:
         E, S, B = idx.shape
-        params0, bn0 = global_vars.params, global_vars.batch_stats
-        mom0 = sgd_init(params0)
+        params0, bn0 = start_vars.params, start_vars.batch_stats
+        # The benign optimizer lives for the whole round (image_train.py:33 is
+        # outside the global-epoch loop), so its momentum chains across
+        # segments; the poison optimizer is fresh per poison epoch
+        # (image_train.py:63 inside the loop) → zero buffers.
+        is_poison_seg = task.poisoning_per_batch > 0
+        zeros = sgd_init(params0)
+        mom0 = _select_tree(is_poison_seg, zeros, benign_mom)
         fg0 = jax.tree_util.tree_map(jnp.zeros_like, params0)
         zeros_e = jnp.zeros((E,), jnp.float32)
         metrics0 = ClientMetrics(zeros_e, zeros_e, zeros_e, zeros_e)
@@ -114,17 +128,18 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
 
         xs = (jnp.arange(E * S), idx.reshape(E * S, B),
               mask.reshape(E * S, B))
-        (params, bn, _mom, fg, metrics), _ = jax.lax.scan(
+        (params, bn, mom, fg, metrics), _ = jax.lax.scan(
             step, (params0, bn0, mom0, fg0, metrics0), xs)
+        # a poison segment leaves the benign buffers untouched
+        benign_mom_out = _select_tree(is_poison_seg, benign_mom, mom)
 
         # Model-replacement scaling over the FULL state (image_train.py:166-171
-        # iterates state_dict — BN stats included), then Δ = w_scaled - w_g.
-        delta = ModelVars(
+        # iterates state_dict — BN stats included) against the segment anchor.
+        end_vars = ModelVars(
             params=jax.tree_util.tree_map(
-                lambda w, g: task.scale * (w - g), params, params0),
+                lambda a, w: a + task.scale * (w - a), params0, params),
             batch_stats=jax.tree_util.tree_map(
-                lambda w, g: task.scale * (w - g), bn, bn0))
-        fg_feature = model_def.similarity_param(fg).reshape(-1)
-        return ClientResult(delta, fg, fg_feature, metrics)
+                lambda a, w: a + task.scale * (w - a), bn0, bn))
+        return SegmentResult(end_vars, benign_mom_out, fg, metrics)
 
     return client_step

@@ -107,8 +107,16 @@ class Experiment:
             from dba_mod_tpu.parallel.mesh import make_mesh
             self.mesh = make_mesh(0 if nd == -1 else nd)
 
+        self.interval = int(params["aggr_epoch_interval"])
+        self.sequential_debug = bool(params.get("sequential_debug", False))
+        if self.sequential_debug and self.mesh is not None:
+            # width-1 client slices cannot tile a sharded clients axis
+            logger.warning("sequential_debug forces single-device execution; "
+                           "ignoring num_devices")
+            self.mesh = None
         self.engine = RoundEngine(params, self.model_def, self.device_data,
-                                  self.eval_plans, mesh=self.mesh)
+                                  self.eval_plans, mesh=self.mesh,
+                                  num_segments=self.interval)
         grad_len = int(np.prod(
             self.model_def.similarity_param(self.global_vars.params).shape))
         self.fg_state = foolsgold_init(self.num_participants, grad_len)
@@ -223,20 +231,28 @@ class Experiment:
 
         slots = np.array([self.client_slots[n] for n in agent_names],
                          np.int64)
-        tasks = build_client_tasks(params, agent_names, epoch, slots,
-                                   self.epochs_max, backdoor_acc)
-        client_epochs = [int(e) for e in tasks.num_epochs]
-        plan = build_batch_plan(
-            [self.client_indices[n] for n in agent_names], client_epochs,
-            int(params["batch_size"]), self.plan_rng,
-            min_steps=self.steps_per_epoch, min_epochs=self.epochs_max)
+        # one segment per global epoch in the aggregation interval
+        # (image_train.py:50: the local model trains continuously across the
+        # interval; the server applies the summed update once)
+        seg_epochs = list(range(epoch, epoch + self.interval))
+        tasks_list, idx_list, mask_list = [], [], []
+        num_samples_np = None
+        for ep in seg_epochs:
+            tasks_s = build_client_tasks(params, agent_names, ep, slots,
+                                         self.epochs_max, backdoor_acc)
+            plan = build_batch_plan(
+                [self.client_indices[n] for n in agent_names],
+                [int(e) for e in tasks_s.num_epochs],
+                int(params["batch_size"]), self.plan_rng,
+                min_steps=self.steps_per_epoch, min_epochs=self.epochs_max)
+            if num_samples_np is None:
+                num_samples_np = plan.num_samples.astype(np.float32)
+            tasks_list.append(tasks_s)
+            idx_list.append(plan.idx)
+            mask_list.append(plan.mask)
 
-        self.rng_key, round_key = jax.random.split(self.rng_key)
-        idx_np, mask_np = plan.idx, plan.mask
-        num_samples_np = plan.num_samples.astype(np.float32)
         if self.mesh is not None:
-            from dba_mod_tpu.parallel.mesh import (pad_clients,
-                                                   shard_round_inputs)
+            from dba_mod_tpu.parallel.mesh import pad_clients
             c_pad = pad_clients(len(agent_names), self.mesh)
             if c_pad != len(agent_names):
                 if params.aggregation != cfg.AGGR_MEAN:
@@ -246,55 +262,113 @@ class Experiment:
                         "multiple (inert-client padding is only sound for "
                         "FedAvg, whose divisor is the static no_models)")
                 pad = c_pad - len(agent_names)
-                tasks = _pad_tasks(tasks, pad, self.epochs_max)
-                idx_np = np.pad(idx_np, ((0, pad),) + ((0, 0),) * 3)
-                mask_np = np.pad(mask_np, ((0, pad),) + ((0, 0),) * 3)
+                tasks_list = [_pad_tasks(t, pad, self.epochs_max)
+                              for t in tasks_list]
+                idx_list = [np.pad(i, ((0, pad),) + ((0, 0),) * 3)
+                            for i in idx_list]
+                mask_list = [np.pad(m, ((0, pad),) + ((0, 0),) * 3)
+                             for m in mask_list]
                 num_samples_np = np.pad(num_samples_np, (0, pad))
-            tasks_dev, idx_dev, mask_dev, ns_dev = shard_round_inputs(
-                self.mesh, jax.tree_util.tree_map(jnp.asarray, tasks),
-                jnp.asarray(idx_np), jnp.asarray(mask_np),
-                jnp.asarray(num_samples_np))
+
+        tasks_seq = jax.tree_util.tree_map(
+            lambda *ls: jnp.asarray(np.stack(ls)), *tasks_list)
+        idx_seq = jnp.asarray(np.stack(idx_list))
+        mask_seq = jnp.asarray(np.stack(mask_list))
+        ns_dev = jnp.asarray(num_samples_np)
+        if self.mesh is not None:
+            from dba_mod_tpu.parallel.mesh import shard_round_inputs
+            tasks_seq, idx_seq, mask_seq, ns_dev = shard_round_inputs(
+                self.mesh, tasks_seq, idx_seq, mask_seq, ns_dev)
+
+        self.rng_key, round_key = jax.random.split(self.rng_key)
+        rng_train, rng_agg = jax.random.split(round_key)
+        lane = jnp.arange(idx_seq.shape[1], dtype=jnp.int32)
+        if self.sequential_debug:
+            train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
+                                           rng_train)
         else:
-            tasks_dev = jax.tree_util.tree_map(jnp.asarray, tasks)
-            idx_dev, mask_dev = jnp.asarray(idx_np), jnp.asarray(mask_np)
-            ns_dev = jnp.asarray(num_samples_np)
-        result = self.engine.round_fn(
-            self.global_vars, self.fg_state, tasks_dev,
-            idx_dev, mask_dev, ns_dev, round_key)
+            train = self.engine.train_fn(self.global_vars, tasks_seq,
+                                         idx_seq, mask_seq, lane, rng_train)
+        tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
+        tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
+        result = self.engine.aggregate_fn(
+            self.global_vars, self.fg_state, train.deltas, train.fg_grads,
+            train.fg_feature, tasks_first.participant_id, ns_dev, rng_agg)
 
         # dispatch every eval before any host sync — one blocking transfer
         locals_dev = (self.engine.local_evals_fn(
-            self.global_vars, result.deltas, tasks_dev)
+            self.global_vars, train.deltas, tasks_last)
             if self.local_eval else None)
         globals_dev = self.engine.global_evals_fn(result.new_vars)
         self.global_vars = result.new_vars
         self.fg_state = result.new_fg_state
         locals_, globals_, metrics, delta_norms, wv, alpha = jax.device_get(
-            (locals_dev, globals_dev, result.metrics, result.delta_norms,
+            (locals_dev, globals_dev, train.metrics, train.delta_norms,
              result.wv, result.alpha))
 
-        self._record(epoch, agent_names, adv_names, tasks, metrics, locals_,
-                     globals_, delta_norms, wv, alpha, t0)
+        self._record(epoch, seg_epochs, agent_names, adv_names, tasks_list,
+                     metrics, locals_, globals_, delta_norms, wv, alpha, t0)
         return {"epoch": epoch, "agents": agent_names,
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
                                  if self.is_poison_run else None),
                 "round_time": time.time() - t0}
 
+    def _train_sequential(self, tasks_seq, idx_seq, mask_seq, rng):
+        """Sequential debug mode (SURVEY §7.2.4): run clients one at a time
+        through the SAME per-client program (width-1 train_fn calls with the
+        true lane index, so rng streams match the vmapped path), then stitch
+        the stacked results back together for the shared aggregation path."""
+        from dba_mod_tpu.fl.rounds import TrainResult
+        C = idx_seq.shape[1]
+        outs = []
+        for c in range(C):
+            t = jax.tree_util.tree_map(lambda l: l[:, c:c + 1], tasks_seq)
+            outs.append(self.engine.train_fn(
+                self.global_vars, t, idx_seq[:, c:c + 1],
+                mask_seq[:, c:c + 1], jnp.asarray([c], jnp.int32), rng))
+        cat0 = lambda *ls: jnp.concatenate(ls, axis=0)
+        cat1 = lambda *ls: jnp.concatenate(ls, axis=1)
+        return TrainResult(
+            deltas=jax.tree_util.tree_map(cat0, *[o.deltas for o in outs]),
+            fg_grads=jax.tree_util.tree_map(cat0,
+                                            *[o.fg_grads for o in outs]),
+            fg_feature=jnp.concatenate([o.fg_feature for o in outs], 0),
+            metrics=jax.tree_util.tree_map(cat1,
+                                           *[o.metrics for o in outs]),
+            delta_norms=jnp.concatenate([o.delta_norms for o in outs], 0))
+
     # ------------------------------------------------------------- recording
-    def _record(self, epoch, agent_names, adv_names, tasks, metrics, locals_,
-                globals_, delta_norms, wv, alpha, t0):
+    def _record(self, epoch, seg_epochs, agent_names, adv_names, tasks_list,
+                metrics, locals_, globals_, delta_norms, wv, alpha, t0):
+        # metrics leaves are [I, C, E]; tasks_list one ClientTask per segment.
+        # Local evals cover the round-final state; for interval > 1 the
+        # reference also evaluates each intermediate epoch — recorded here
+        # only for the final one (all reference configs use interval 1).
         params = self.params
         rec = self.recorder
+        tasks = tasks_list[-1]
+        # per-client flags hold if ANY segment of the round poisoned
+        # (a client may poison at epoch 3 of a (3,4) interval round)
+        poisoning_any = np.zeros(len(agent_names), bool)
+        adv_slot_any = np.full(len(agent_names), -1, np.int64)
+        for t in tasks_list:
+            poisoning_any |= np.asarray(t.poisoning_per_batch)[
+                :len(agent_names)] > 0
+            adv_slot_any = np.maximum(adv_slot_any,
+                                      np.asarray(t.adv_slot)
+                                      [:len(agent_names)])
         for c, name in enumerate(agent_names):
-            n_e = int(tasks.num_epochs[c])
-            for e in range(n_e):
-                count = max(float(metrics.count[c, e]), 1.0)
-                rec.add_train(name, (epoch - 1) * n_e + e + 1, epoch, e + 1,
-                              float(metrics.loss_sum[c, e]) / count,
-                              100.0 * float(metrics.correct[c, e]) / count,
-                              int(metrics.correct[c, e]), int(count))
-            poisoning = int(tasks.poisoning_per_batch[c]) > 0
+            for s, ep in enumerate(seg_epochs):
+                n_e = int(tasks_list[s].num_epochs[c])
+                for e in range(n_e):
+                    count = max(float(metrics.count[s, c, e]), 1.0)
+                    rec.add_train(name, (ep - 1) * n_e + e + 1, ep, e + 1,
+                                  float(metrics.loss_sum[s, c, e]) / count,
+                                  100.0 * float(metrics.correct[s, c, e])
+                                  / count,
+                                  int(metrics.correct[s, c, e]), int(count))
+            poisoning = bool(poisoning_any[c])
             baseline = bool(params["baseline"])
             if locals_ is not None:
                 lr = locals_
@@ -319,7 +393,7 @@ class Experiment:
                                        int(lr.poison_post.correct[c]),
                                        int(lr.poison_post.count[c]))
                 if (self.is_poison_run and
-                        int(tasks.adv_slot[c]) >= 0):
+                        int(adv_slot_any[c]) >= 0):
                     rec.add_triggertest(
                         name, f"{name}_trigger", "", epoch,
                         float(lr.agent_trigger.loss[c]),
@@ -385,14 +459,9 @@ class Experiment:
     def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
         last: Dict[str, Any] = {}
         end = epochs if epochs is not None else int(self.params["epochs"])
-        interval = int(self.params["aggr_epoch_interval"])
-        if interval != 1:
-            raise NotImplementedError(
-                "aggr_epoch_interval != 1 is not supported yet (all reference "
-                "configs use 1; see utils/*_params.yaml)")
         profile_dir = str(self.params.get("profile_dir", "") or "")
-        for epoch in range(self.start_epoch, end + 1, interval):
-            if profile_dir and epoch == self.start_epoch + 1:
+        for epoch in range(self.start_epoch, end + 1, self.interval):
+            if profile_dir and epoch == self.start_epoch + self.interval:
                 # trace the first post-compile round (SURVEY §5 tracing row)
                 with jax.profiler.trace(profile_dir):
                     last = self.run_round(epoch)

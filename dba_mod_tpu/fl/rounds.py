@@ -1,11 +1,22 @@
-"""The round engine: one jitted XLA computation per FL round, plus the jitted
+"""The round engine: jitted train + aggregate computations, plus the jitted
 local/global evaluation batteries.
 
-Replaces main.py:135-231's sequential orchestration: the round computation
-vmaps the client step over the stacked clients axis, feeds the stacked deltas
-straight into the configured aggregator, and returns the new global state —
-server→client broadcast and client→server upload are XLA data flow, not
-host dict-copies (contrast image_train.py:32, helper.py:223-227).
+Replaces main.py:135-231's sequential orchestration. A round is:
+
+  train_fn   — for each `aggr_epoch_interval` segment (global epoch), the
+               vmapped client step runs all clients in parallel, chaining each
+               client's state across segments (the reference's local model
+               trains continuously within a round, re-anchoring its distance
+               loss and scaling at each global epoch — image_train.py:50-54,
+               :306); emits Δ = w_end - w_global plus FoolsGold gradient
+               accumulators and per-segment metrics.
+  aggregate_fn — the configured rule over the stacked deltas.
+
+Splitting the two lets the sequential debug mode (SURVEY §7.2.4) run clients
+one at a time through the identical per-client program and still share the
+aggregation path. Server→client broadcast and client→server upload are XLA
+data flow, not host dict-copies (contrast image_train.py:32,
+helper.py:223-227).
 """
 from __future__ import annotations
 
@@ -23,14 +34,20 @@ from dba_mod_tpu.fl.device_data import DeviceData
 from dba_mod_tpu.fl.evaluation import EvalResult, make_eval_fn
 from dba_mod_tpu.fl.state import ClientTask, RoundHyper
 from dba_mod_tpu.ops import aggregation as agg
+from dba_mod_tpu.ops.losses import tree_global_norm
 
 
-class RoundResult(NamedTuple):
+class TrainResult(NamedTuple):
+    deltas: ModelVars             # stacked [C, ...]: w_end - w_global
+    fg_grads: Any                 # [C, ...] grads accumulated over the round
+    fg_feature: jax.Array         # [C, L] similarity-layer grad, flattened
+    metrics: ClientMetrics        # [I, C, E] per segment/client/epoch
+    delta_norms: jax.Array        # [C] ‖Δ_params‖ — scale_result.csv distance
+
+
+class AggregateResult(NamedTuple):
     new_vars: ModelVars
     new_fg_state: agg.FoolsGoldState
-    metrics: ClientMetrics        # stacked [C, E]
-    deltas: ModelVars             # stacked [C, ...] (for local evals)
-    delta_norms: jax.Array        # [C] ‖Δ_params‖ — scale_result.csv distance
     wv: jax.Array                 # [C] aggregation weights (RFA/FoolsGold)
     alpha: jax.Array              # [C] RFA distances / FoolsGold alphas
     num_oracle_calls: jax.Array   # RFA oracle counter (1 otherwise)
@@ -71,13 +88,15 @@ class RoundEngine:
     aggregation reductions lower to ICI collectives (SURVEY §2.2)."""
 
     def __init__(self, params: cfg.Params, model_def: ModelDef,
-                 data: DeviceData, plans: EvalPlans, mesh=None):
+                 data: DeviceData, plans: EvalPlans, mesh=None,
+                 num_segments: int = 1):
         self.params = params
         self.hyper = RoundHyper.from_params(params)
         self.model_def = model_def
         self.data = data
         self.plans = plans
         self.mesh = mesh
+        self.num_segments = num_segments
         hyper = self.hyper
         fg_enabled = hyper.aggregation == cfg.AGGR_FOOLSGOLD
         client_step = make_client_step(model_def, data, hyper, fg_enabled)
@@ -85,36 +104,69 @@ class RoundEngine:
         eval_poison = make_eval_fn(model_def, data, poison=True)
         is_poison_run = bool(params["is_poison"])
 
-        def round_fn(global_vars: ModelVars, fg_state: agg.FoolsGoldState,
-                     tasks: ClientTask, idx, mask, num_samples,
-                     rng) -> RoundResult:
-            C = idx.shape[0]
-            rng, dp_rng = jax.random.split(rng)
-            client_rngs = jax.random.split(rng, C)
-            res = jax.vmap(client_step, in_axes=(None, 0, 0, 0, 0))(
-                global_vars, tasks, idx, mask, client_rngs)
+        def train_fn(global_vars: ModelVars, tasks_seq: ClientTask, idx_seq,
+                     mask_seq, lane, rng) -> TrainResult:
+            # tasks_seq leaves [I, C, ...]; idx/mask [I, C, E, S, B];
+            # lane [C] — absolute lane index so per-client rng streams are
+            # identical between the vmapped and sequential-debug paths
+            n_seg, C = idx_seq.shape[0], idx_seq.shape[1]
+            start = jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(l, (C,) + l.shape), global_vars)
+            benign_mom = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((C,) + l.shape), global_vars.params)
+            fg_total = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((C,) + l.shape), global_vars.params)
+            seg_metrics = []
+            for s in range(n_seg):  # static unroll; n_seg is 1 in practice
+                seg_rng = jax.random.fold_in(rng, s)
+                rngs = jax.vmap(
+                    lambda i: jax.random.fold_in(seg_rng, i))(lane)
+                tasks_s = jax.tree_util.tree_map(lambda l: l[s], tasks_seq)
+                res = jax.vmap(client_step)(start, benign_mom, tasks_s,
+                                            idx_seq[s], mask_seq[s], rngs)
+                start = res.end_vars
+                benign_mom = res.benign_mom
+                if fg_enabled:
+                    fg_total = jax.tree_util.tree_map(jnp.add, fg_total,
+                                                      res.fg_grads)
+                seg_metrics.append(res.metrics)
+            deltas = jax.tree_util.tree_map(lambda e, g: e - g, start,
+                                            global_vars)
+            fg_feature = jax.vmap(
+                lambda t: model_def.similarity_param(t).reshape(-1))(fg_total)
+            metrics = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *seg_metrics)
+            delta_norms = jax.vmap(
+                lambda d: tree_global_norm(d.params))(deltas)
+            return TrainResult(deltas, fg_total, fg_feature, metrics,
+                               delta_norms)
 
+        def aggregate_fn(global_vars: ModelVars,
+                         fg_state: agg.FoolsGoldState, deltas: ModelVars,
+                         fg_grads, fg_feature, participant_ids, num_samples,
+                         rng) -> AggregateResult:
+            C = fg_feature.shape[0]
             wv = jnp.zeros((C,), jnp.float32)
             alpha = jnp.zeros((C,), jnp.float32)
             calls = jnp.int32(1)
             new_fg = fg_state
             if hyper.aggregation == cfg.AGGR_MEAN:
                 new_vars = agg.fedavg_update(
-                    global_vars, res.delta, hyper.eta, hyper.no_models,
-                    hyper.sigma if hyper.diff_privacy else 0.0, dp_rng)
+                    global_vars, deltas, hyper.eta, hyper.no_models,
+                    hyper.sigma if hyper.diff_privacy else 0.0, rng)
             elif hyper.aggregation == cfg.AGGR_GEO_MED:
                 r = agg.geometric_median_update(
-                    global_vars, res.delta, num_samples, hyper.eta,
+                    global_vars, deltas, num_samples, hyper.eta,
                     maxiter=hyper.geom_median_maxiter,
                     max_update_norm=hyper.max_update_norm,
                     dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
-                    rng=dp_rng)
+                    rng=rng)
                 new_vars, calls, wv, alpha = (r.new_state, r.num_oracle_calls,
                                               r.wv, r.distances)
             else:  # foolsgold
                 r = agg.foolsgold_update(
-                    global_vars.params, res.fg_grads, res.fg_feature,
-                    tasks.participant_id, fg_state, hyper.eta, hyper.lr,
+                    global_vars.params, fg_grads, fg_feature,
+                    participant_ids, fg_state, hyper.eta, hyper.lr,
                     hyper.momentum, hyper.weight_decay,
                     use_memory=hyper.fg_use_memory)
                 # BN stats are not aggregated by FoolsGold (the reference
@@ -122,23 +174,25 @@ class RoundEngine:
                 # helper.py:286-290)
                 new_vars = ModelVars(r.new_params, global_vars.batch_stats)
                 new_fg, wv, alpha = r.new_fg_state, r.wv, r.alpha
-            from dba_mod_tpu.ops.losses import tree_global_norm
-            delta_norms = jax.vmap(
-                lambda d: tree_global_norm(d.params))(res.delta)
-            return RoundResult(new_vars, new_fg, res.metrics, res.delta,
-                               delta_norms, wv, alpha, calls)
+            return AggregateResult(new_vars, new_fg, wv, alpha, calls)
 
         if mesh is not None:
-            from dba_mod_tpu.parallel.mesh import (client_sharding,
+            from dba_mod_tpu.parallel.mesh import (CLIENTS_AXIS,
+                                                   client_sharding,
                                                    replicated_sharding)
+            from jax.sharding import NamedSharding, PartitionSpec as P
             rep = replicated_sharding(mesh)
             cs = client_sharding(mesh)
-            # (global_vars, fg_state, tasks, idx, mask, num_samples, rng) —
-            # pytree-prefix shardings; outputs left to the partitioner.
-            self.round_fn = jax.jit(
-                round_fn, in_shardings=(rep, rep, cs, cs, cs, cs, rep))
+            seg_cs = NamedSharding(mesh, P(None, CLIENTS_AXIS))
+            self.train_fn = jax.jit(
+                train_fn, in_shardings=(rep, seg_cs, seg_cs, seg_cs, cs,
+                                        rep))
+            self.aggregate_fn = jax.jit(
+                aggregate_fn,
+                in_shardings=(rep, rep, cs, cs, cs, cs, cs, rep))
         else:
-            self.round_fn = jax.jit(round_fn)
+            self.train_fn = jax.jit(train_fn)
+            self.aggregate_fn = jax.jit(aggregate_fn)
 
         def local_evals(global_vars: ModelVars, deltas: ModelVars,
                         tasks: ClientTask) -> LocalEvals:

@@ -43,12 +43,20 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_round_inputs(mesh: Mesh, tasks: Any, idx, mask, num_samples):
-    """Place one round's stacked inputs with clients-axis sharding."""
-    cs = client_sharding(mesh)
-    put = lambda t: jax.device_put(t, cs)
-    return (jax.tree_util.tree_map(put, tasks), put(idx), put(mask),
-            put(num_samples))
+def segment_client_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for segment-leading stacks: leaves are [segments, clients,
+    ...] — replicate the segment axis, shard clients."""
+    return NamedSharding(mesh, P(None, CLIENTS_AXIS))
+
+
+def shard_round_inputs(mesh: Mesh, tasks_seq: Any, idx_seq, mask_seq,
+                       num_samples):
+    """Place one round's segment-stacked inputs ([I, C, ...] leaves) with
+    clients-axis sharding; num_samples is [C]."""
+    seg_cs = segment_client_sharding(mesh)
+    put = lambda t: jax.device_put(t, seg_cs)
+    return (jax.tree_util.tree_map(put, tasks_seq), put(idx_seq),
+            put(mask_seq), jax.device_put(num_samples, client_sharding(mesh)))
 
 
 def pad_clients(n_clients: int, mesh: Optional[Mesh]) -> int:

@@ -91,3 +91,26 @@ def test_defaults_fill_in():
     assert p["momentum"] == 0.9
     assert p["fg_use_memory"] is True
     assert p["is_poison"] is False
+
+
+def test_loads_reference_yamls_verbatim():
+    """Schema compatibility: the reference's own config files must load and
+    resolve through the typed accessors."""
+    import os
+    ref = "/root/reference/utils"
+    if not os.path.isdir(ref):
+        pytest.skip("reference not mounted")
+    for name, typ in [("mnist_params.yaml", "mnist"),
+                      ("cifar_params.yaml", "cifar"),
+                      ("tiny_params.yaml", "tiny-imagenet-200"),
+                      ("loan_params.yaml", "loan")]:
+        p = cfg.Params.from_yaml(os.path.join(ref, name))
+        assert p.type == typ
+        assert p.num_adversaries >= 1
+        for slot in range(p.num_adversaries):
+            assert len(p.poison_epochs_for(slot)) >= 1
+        if p.is_image:
+            assert len(p.poison_pattern_for(-1)) > 0
+        else:
+            names, values = p.poison_trigger_features_for(-1)
+            assert len(names) == len(values) > 0

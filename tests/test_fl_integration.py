@@ -124,3 +124,63 @@ def test_bf16_compute_path():
     # params stayed f32
     assert all(l.dtype == jnp.float32
                for l in jax.tree_util.tree_leaves(e.global_vars.params))
+
+
+def test_centralized_attack_mode():
+    """Single adversary = centralized mode: it trains on the COMBINED pattern
+    (adversarial_index -1, image_train.py:47-48) and the global battery tests
+    each sub-pattern by index, gated on centralized_test_trigger
+    (main.py:225-228)."""
+    cfg_d = dict(POISON, adversary_list=[0],
+                 **{"0_poison_epochs": [2, 3, 4]})
+    e = Experiment(Params.from_dict(cfg_d), save_results=False)
+    for i in range(1, 5):
+        r = e.run_round(i)
+    assert r["backdoor_acc"] > 80.0
+    names = {row[1] for row in e.recorder.poisontriggertest_result
+             if row[0] == "global"}
+    assert "global_in_index_0_trigger" in names
+    assert "global_in_index_1_trigger" in names
+
+    # gate off: per-index rows disappear, combined row stays
+    cfg_d2 = dict(cfg_d, centralized_test_trigger=False)
+    e2 = Experiment(Params.from_dict(cfg_d2), save_results=False)
+    e2.run_round(2)
+    names2 = {row[1] for row in e2.recorder.poisontriggertest_result
+              if row[0] == "global"}
+    assert "combine" in names2
+    assert not any("global_in_index" in n for n in names2)
+
+
+def test_aggr_epoch_interval_two():
+    """interval=2: clients train two consecutive global epochs without
+    re-sync; poison scheduling applies per epoch; the server applies the
+    summed update once per round (main.py:135, helper.py:218-222)."""
+    cfg_d = dict(POISON, aggr_epoch_interval=2, epochs=6, local_eval=False)
+    e = Experiment(Params.from_dict(cfg_d), save_results=False)
+    out = {}
+    for i in (1, 3, 5):
+        out[i] = e.run_round(i)
+        assert np.isfinite(out[i]["global_acc"])
+    # adversary 0 poisons at epochs 3-6 → rounds starting at 3 and 5
+    assert out[5]["backdoor_acc"] > 80.0
+    # train rows carry per-segment epochs: both 5 and 6 appear
+    epochs_seen = {r[2] for r in e.recorder.train_result}
+    assert {1, 2, 3, 4, 5, 6} <= epochs_seen
+
+
+def test_sequential_debug_matches_vmapped():
+    """The strictly-sequential debug path (SURVEY §7.2.4) reproduces the
+    vmapped round: same per-lane rng streams, same deltas, same aggregate."""
+    import jax
+    cfg_v = dict(POISON, epochs=2, local_eval=False)
+    e_v = Experiment(Params.from_dict(cfg_v), save_results=False)
+    e_s = Experiment(Params.from_dict(dict(cfg_v, sequential_debug=True)),
+                     save_results=False)
+    for i in (1, 2, 3):
+        rv = e_v.run_round(i)
+        rs = e_s.run_round(i)
+    assert abs(rv["global_acc"] - rs["global_acc"]) < 0.5
+    lv = jax.tree_util.tree_leaves(e_v.global_vars.params)[0]
+    ls = jax.tree_util.tree_leaves(e_s.global_vars.params)[0]
+    np.testing.assert_allclose(np.asarray(lv), np.asarray(ls), atol=2e-3)
