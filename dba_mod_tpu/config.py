@@ -68,6 +68,13 @@ _DEFAULTS: Dict[str, Any] = {
     "save_on_epochs": [],
     "resumed_model": False,
     "resumed_model_name": "",
+    # per-batch tracking channels (reference image_train.py:108-117, :232-246;
+    # the reference only plots these to visdom — here they are recorded)
+    "vis_train_batch_loss": False,
+    "batch_track_distance": False,
+    # RFA update-norm rejection threshold (reference helper.py:360-369; its
+    # MAX_UPDATE_NORM constant at config.py:7 is dormant — None keeps parity)
+    "max_update_norm": None,
     "environment_name": "dba_tpu",
     "log_interval": 2,
     "results_json": True,

@@ -54,6 +54,10 @@ class SegmentResult(NamedTuple):
     benign_mom: Any          # benign-optimizer momentum after this segment
     fg_grads: Any            # grads accumulated THIS segment (params tree)
     metrics: ClientMetrics
+    batch_loss: jax.Array    # [E*S] per-batch loss (vis_train_batch_loss,
+                             # image_train.py:225-235); zeros when tracking off
+    batch_dist: jax.Array    # [E*S] post-step ‖w-w_anchor‖ (batch_track_
+                             # distance, image_train.py:236-245); zeros off
 
 
 def _select_tree(pred, new, old):
@@ -124,12 +128,18 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
                 count=m.count.at[e].add(vf * jnp.sum(bmaskf)),
                 poison_count=m.poison_count.at[e].add(
                     vf * jnp.sum(sel * bmaskf)))
-            return (params, bn, mom, fg, m), None
+            if hyper.track_batches:
+                # the reference measures the distance AFTER the step
+                # (image_train.py:238: optimizer.step() precedes it)
+                ys = (vf * loss, vf * tree_dist_norm(params, params0))
+            else:
+                ys = (jnp.float32(0), jnp.float32(0))
+            return (params, bn, mom, fg, m), ys
 
         xs = (jnp.arange(E * S), idx.reshape(E * S, B),
               mask.reshape(E * S, B))
-        (params, bn, mom, fg, metrics), _ = jax.lax.scan(
-            step, (params0, bn0, mom0, fg0, metrics0), xs)
+        (params, bn, mom, fg, metrics), (batch_loss, batch_dist) = \
+            jax.lax.scan(step, (params0, bn0, mom0, fg0, metrics0), xs)
         # a poison segment leaves the benign buffers untouched
         benign_mom_out = _select_tree(is_poison_seg, benign_mom, mom)
 
@@ -140,6 +150,7 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
                 lambda a, w: a + task.scale * (w - a), params0, params),
             batch_stats=jax.tree_util.tree_map(
                 lambda a, w: a + task.scale * (w - a), bn0, bn))
-        return SegmentResult(end_vars, benign_mom_out, fg, metrics)
+        return SegmentResult(end_vars, benign_mom_out, fg, metrics,
+                             batch_loss, batch_dist)
 
     return client_step

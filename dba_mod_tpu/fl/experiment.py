@@ -302,12 +302,18 @@ class Experiment:
         globals_dev = self.engine.global_evals_fn(result.new_vars)
         self.global_vars = result.new_vars
         self.fg_state = result.new_fg_state
-        locals_, globals_, metrics, delta_norms, wv, alpha = jax.device_get(
+        track = (bool(params.get("vis_train_batch_loss"))
+                 or bool(params.get("batch_track_distance")))
+        batch_dev = (train.batch_loss, train.batch_dist) if track else None
+        (locals_, globals_, metrics, delta_norms, wv, alpha,
+         batches, is_updated) = jax.device_get(
             (locals_dev, globals_dev, train.metrics, train.delta_norms,
-             result.wv, result.alpha))
+             result.wv, result.alpha, batch_dev, result.is_updated))
+        self.last_is_updated = bool(is_updated)
 
         self._record(epoch, seg_epochs, agent_names, adv_names, tasks_list,
-                     metrics, locals_, globals_, delta_norms, wv, alpha, t0)
+                     metrics, locals_, globals_, delta_norms, wv, alpha, t0,
+                     batches, mask_list)
         return {"epoch": epoch, "agents": agent_names,
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
@@ -336,11 +342,14 @@ class Experiment:
             fg_feature=jnp.concatenate([o.fg_feature for o in outs], 0),
             metrics=jax.tree_util.tree_map(cat1,
                                            *[o.metrics for o in outs]),
-            delta_norms=jnp.concatenate([o.delta_norms for o in outs], 0))
+            delta_norms=jnp.concatenate([o.delta_norms for o in outs], 0),
+            batch_loss=jnp.concatenate([o.batch_loss for o in outs], 1),
+            batch_dist=jnp.concatenate([o.batch_dist for o in outs], 1))
 
     # ------------------------------------------------------------- recording
     def _record(self, epoch, seg_epochs, agent_names, adv_names, tasks_list,
-                metrics, locals_, globals_, delta_norms, wv, alpha, t0):
+                metrics, locals_, globals_, delta_norms, wv, alpha, t0,
+                batches=None, mask_list=None):
         # metrics leaves are [I, C, E]; tasks_list one ClientTask per segment.
         # Local evals cover the round-final state; for interval > 1 the
         # reference also evaluates each intermediate epoch — recorded here
@@ -368,6 +377,24 @@ class Experiment:
                                   100.0 * float(metrics.correct[s, c, e])
                                   / count,
                                   int(metrics.correct[s, c, e]), int(count))
+                if batches is not None:
+                    # [I, C, E*S] per-batch channels; only steps whose batch
+                    # mask is non-empty ran (padded epochs/steps are no-ops)
+                    bloss, bdist = batches
+                    S = mask_list[s].shape[2]
+                    valid = mask_list[s][c].any(axis=-1).reshape(-1)  # [E*S]
+                    want_loss = bool(params.get("vis_train_batch_loss"))
+                    want_dist = bool(params.get("batch_track_distance"))
+                    for st in np.nonzero(valid)[0]:
+                        e_i, b_i = int(st) // S, int(st) % S
+                        tle = (ep - 1) * n_e + e_i + 1
+                        if want_loss:
+                            rec.add_batch_loss(name, tle, ep, e_i + 1, b_i, S,
+                                               float(bloss[s, c, st]))
+                        if want_dist:
+                            rec.add_batch_distance(
+                                name, tle, ep, e_i + 1, b_i, S,
+                                float(bdist[s, c, st]))
             poisoning = bool(poisoning_any[c])
             baseline = bool(params["baseline"])
             if locals_ is not None:
@@ -432,10 +459,11 @@ class Experiment:
             rec.scale_temp_one_row.append(round(float(globals_.clean.acc), 4))
         if self.params.aggregation != cfg.AGGR_MEAN:
             rec.add_weight_result(list(agent_names), wv.tolist(),
-                                  alpha.tolist())
+                                  alpha.tolist(), epoch=epoch)
         rec.add_round_json(
             epoch=epoch, agents=[str(a) for a in agent_names],
             adversaries=[str(a) for a in adv_names],
+            is_updated=self.last_is_updated,
             global_acc=float(globals_.clean.acc),
             global_loss=float(globals_.clean.loss),
             backdoor_acc=(float(globals_.poison.acc)

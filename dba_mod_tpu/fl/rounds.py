@@ -43,6 +43,10 @@ class TrainResult(NamedTuple):
     fg_feature: jax.Array         # [C, L] similarity-layer grad, flattened
     metrics: ClientMetrics        # [I, C, E] per segment/client/epoch
     delta_norms: jax.Array        # [C] ‖Δ_params‖ — scale_result.csv distance
+    batch_loss: jax.Array         # [I, C, E*S] per-batch loss (zeros when
+                                  # vis_train_batch_loss is off)
+    batch_dist: jax.Array         # [I, C, E*S] per-batch post-step distance
+                                  # (zeros when batch_track_distance is off)
 
 
 class AggregateResult(NamedTuple):
@@ -51,6 +55,8 @@ class AggregateResult(NamedTuple):
     wv: jax.Array                 # [C] aggregation weights (RFA/FoolsGold)
     alpha: jax.Array              # [C] RFA distances / FoolsGold alphas
     num_oracle_calls: jax.Array   # RFA oracle counter (1 otherwise)
+    is_updated: jax.Array         # bool — False iff RFA's max_update_norm
+                                  # rejected the round (helper.py:360-369)
 
 
 class LocalEvals(NamedTuple):
@@ -117,6 +123,7 @@ class RoundEngine:
             fg_total = jax.tree_util.tree_map(
                 lambda l: jnp.zeros((C,) + l.shape), global_vars.params)
             seg_metrics = []
+            seg_bloss, seg_bdist = [], []
             for s in range(n_seg):  # static unroll; n_seg is 1 in practice
                 seg_rng = jax.random.fold_in(rng, s)
                 rngs = jax.vmap(
@@ -130,6 +137,8 @@ class RoundEngine:
                     fg_total = jax.tree_util.tree_map(jnp.add, fg_total,
                                                       res.fg_grads)
                 seg_metrics.append(res.metrics)
+                seg_bloss.append(res.batch_loss)
+                seg_bdist.append(res.batch_dist)
             deltas = jax.tree_util.tree_map(lambda e, g: e - g, start,
                                             global_vars)
             fg_feature = jax.vmap(
@@ -139,7 +148,8 @@ class RoundEngine:
             delta_norms = jax.vmap(
                 lambda d: tree_global_norm(d.params))(deltas)
             return TrainResult(deltas, fg_total, fg_feature, metrics,
-                               delta_norms)
+                               delta_norms, jnp.stack(seg_bloss),
+                               jnp.stack(seg_bdist))
 
         def aggregate_fn(global_vars: ModelVars,
                          fg_state: agg.FoolsGoldState, deltas: ModelVars,
@@ -149,6 +159,7 @@ class RoundEngine:
             wv = jnp.zeros((C,), jnp.float32)
             alpha = jnp.zeros((C,), jnp.float32)
             calls = jnp.int32(1)
+            is_updated = jnp.asarray(True)
             new_fg = fg_state
             if hyper.aggregation == cfg.AGGR_MEAN:
                 new_vars = agg.fedavg_update(
@@ -163,6 +174,7 @@ class RoundEngine:
                     rng=rng)
                 new_vars, calls, wv, alpha = (r.new_state, r.num_oracle_calls,
                                               r.wv, r.distances)
+                is_updated = r.is_updated
             else:  # foolsgold
                 r = agg.foolsgold_update(
                     global_vars.params, fg_grads, fg_feature,
@@ -174,7 +186,8 @@ class RoundEngine:
                 # helper.py:286-290)
                 new_vars = ModelVars(r.new_params, global_vars.batch_stats)
                 new_fg, wv, alpha = r.new_fg_state, r.wv, r.alpha
-            return AggregateResult(new_vars, new_fg, wv, alpha, calls)
+            return AggregateResult(new_vars, new_fg, wv, alpha, calls,
+                                   is_updated)
 
         if mesh is not None:
             from dba_mod_tpu.parallel.mesh import (CLIENTS_AXIS,
@@ -184,9 +197,17 @@ class RoundEngine:
             rep = replicated_sharding(mesh)
             cs = client_sharding(mesh)
             seg_cs = NamedSharding(mesh, P(None, CLIENTS_AXIS))
+            # out_shardings must be pinned: without them XLA may return
+            # constant-foldable outputs (e.g. the all-zero fg_grads tree when
+            # FoolsGold is off) replicated, and aggregate_fn's P('clients')
+            # in_shardings then reject them at the call boundary.
+            out_shard = TrainResult(deltas=cs, fg_grads=cs, fg_feature=cs,
+                                    metrics=seg_cs, delta_norms=cs,
+                                    batch_loss=seg_cs, batch_dist=seg_cs)
             self.train_fn = jax.jit(
                 train_fn, in_shardings=(rep, seg_cs, seg_cs, seg_cs, cs,
-                                        rep))
+                                        rep),
+                out_shardings=out_shard)
             self.aggregate_fn = jax.jit(
                 aggregate_fn,
                 in_shardings=(rep, rep, cs, cs, cs, cs, cs, rep))

@@ -54,9 +54,11 @@ class RoundHyper:
     sigma: float
     geom_median_maxiter: int
     max_update_norm: float | None = None
+    track_batches: bool = False
 
     @classmethod
     def from_params(cls, p: cfg.Params) -> "RoundHyper":
+        mun = p.get("max_update_norm")
         return cls(momentum=float(p["momentum"]),
                    weight_decay=float(p["decay"]),
                    poison_label_swap=int(p["poison_label_swap"]),
@@ -66,7 +68,10 @@ class RoundHyper:
                    fg_use_memory=bool(p["fg_use_memory"]),
                    diff_privacy=bool(p["diff_privacy"]),
                    sigma=float(p["sigma"]),
-                   geom_median_maxiter=int(p["geom_median_maxiter"]))
+                   geom_median_maxiter=int(p["geom_median_maxiter"]),
+                   max_update_norm=(None if mun is None else float(mun)),
+                   track_batches=bool(p.get("vis_train_batch_loss")
+                                      or p.get("batch_track_distance")))
 
 
 def build_client_tasks(params: cfg.Params, agent_names: list, epoch: int,

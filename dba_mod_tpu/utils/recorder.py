@@ -1,9 +1,25 @@
 """Result recording with column-schema parity to the reference's CSVs
 (utils/csv_record.py) so curves can be diffed directly, plus a JSONL metrics
-stream for modern tooling.
+stream and (opt-in) TensorBoard scalar series covering every live visdom chart
+family the reference ships (models/simple.py:18-200; call sites main.py:39-83,
+image_train.py:108-297, test.py:47,112) — SURVEY §5 replaces visdom with
+TensorBoard, so each chart family maps to a named TB tag (see PARITY.md):
+
+  visdom window              TB tag family
+  train_acc / train_loss   → train/acc/{client}, train/loss/{client}
+  train_batch_loss         → train_batch/loss/{client}
+  global_dist              → distance_to_global/{client}
+  Aggregation_Weight       → aggregation/weight/{client}
+  FG_Alpha                 → aggregation/alpha/{client}
+  test_acc / test_loss     → test/acc/{model}, test/loss/{model}
+  poison_test_acc/loss     → poison_test/acc/{model}, poison_test/loss/{model}
+  poison_triggerweight_vis_acc / poison_state_trigger_acc
+                           → trigger_test/acc/{model}.{trigger}, .../loss/...
 
 Like the reference, `save()` rewrites every CSV each round (csv_record.py:21-59
 — crash-safe tail); unlike it, state lives on an instance, not module globals.
+The per-batch channels (train_batch/distance) additionally land in CSVs of
+their own — the reference only plotted them.
 """
 from __future__ import annotations
 
@@ -19,6 +35,12 @@ TEST_HEADER = ["model", "epoch", "average_loss", "accuracy", "correct_data",
                "total_data"]
 TRIGGER_HEADER = ["model", "trigger_name", "trigger_value", "epoch",
                   "average_loss", "accuracy", "correct_data", "total_data"]
+BATCH_HEADER = ["local_model", "round", "epoch", "internal_epoch", "batch",
+                "value"]
+
+
+def _tag(name: Any) -> str:
+    return str(name).replace("/", "_")
 
 
 class Recorder:
@@ -38,32 +60,76 @@ class Recorder:
         self.weight_result: List[list] = []
         self.scale_result: List[list] = []
         self.scale_temp_one_row: List[Any] = []
+        self.batch_loss_result: List[list] = []
+        self.batch_distance_result: List[list] = []
         self._jsonl_rows: List[dict] = []
+
+    def _scalar(self, tag: str, value: float, step: int):
+        if self._tb is not None:
+            self._tb.scalar(tag, float(value), int(step))
 
     # ------------------------------------------------------------------ adds
     def add_train(self, name, temp_local_epoch, epoch, internal_epoch, loss,
                   acc, correct, total):
         self.train_result.append([name, temp_local_epoch, epoch,
                                   internal_epoch, loss, acc, correct, total])
+        # train_vis (models/simple.py:18-31): x = temp_local_epoch
+        self._scalar(f"train/acc/{_tag(name)}", acc, temp_local_epoch)
+        self._scalar(f"train/loss/{_tag(name)}", loss, temp_local_epoch)
 
     def add_test(self, name, epoch, loss, acc, correct, total):
         self.test_result.append([name, epoch, loss, acc, correct, total])
+        # test_vis (models/simple.py:178-200, test.py:47)
+        self._scalar(f"test/acc/{_tag(name)}", acc, epoch)
+        self._scalar(f"test/loss/{_tag(name)}", loss, epoch)
 
     def add_poisontest(self, name, epoch, loss, acc, correct, total):
         self.posiontest_result.append([name, epoch, loss, acc, correct,
                                        total])
+        # poison_test_vis (models/simple.py:131-153, test.py:112)
+        self._scalar(f"poison_test/acc/{_tag(name)}", acc, epoch)
+        self._scalar(f"poison_test/loss/{_tag(name)}", loss, epoch)
 
     def add_triggertest(self, model, trigger_name, trigger_value, epoch, loss,
                         acc, correct, total):
         self.poisontriggertest_result.append(
             [model, trigger_name, trigger_value, epoch, loss, acc, correct,
              total])
+        # trigger_test_vis / trigger_agent_test_vis (models/simple.py:88-129,
+        # main.py:39-58, image_train.py:287-297)
+        tag = f"{_tag(model)}.{_tag(trigger_name)}"
+        self._scalar(f"trigger_test/acc/{tag}", acc, epoch)
+        self._scalar(f"trigger_test/loss/{tag}", loss, epoch)
 
-    def add_weight_result(self, names, weights, alphas):
+    def add_weight_result(self, names, weights, alphas, epoch=None):
         # reference appends three rows per round (csv_record.py:61-64)
         self.weight_result.append(list(names))
         self.weight_result.append(list(weights))
         self.weight_result.append(list(alphas))
+        # weight_vis / alpha_vis (models/simple.py:62-87, main.py:60-83)
+        if epoch is not None:
+            for n, w, a in zip(names, weights, alphas):
+                self._scalar(f"aggregation/weight/{_tag(n)}", w, epoch)
+                self._scalar(f"aggregation/alpha/{_tag(n)}", a, epoch)
+
+    def add_batch_loss(self, name, temp_local_epoch, epoch, internal_epoch,
+                       batch, steps_per_epoch, loss):
+        """Per-batch train loss (vis_train_batch_loss,
+        image_train.py:225-235; train_batch_vis models/simple.py:32-42)."""
+        self.batch_loss_result.append(
+            [name, temp_local_epoch, epoch, internal_epoch, batch, loss])
+        step = (temp_local_epoch - 1) * steps_per_epoch + batch
+        self._scalar(f"train_batch/loss/{_tag(name)}", loss, step)
+
+    def add_batch_distance(self, name, temp_local_epoch, epoch,
+                           internal_epoch, batch, steps_per_epoch, dist):
+        """Per-batch post-step distance to the round anchor
+        (batch_track_distance, image_train.py:236-245;
+        track_distance_batch_vis models/simple.py:43-61)."""
+        self.batch_distance_result.append(
+            [name, temp_local_epoch, epoch, internal_epoch, batch, dist])
+        step = (temp_local_epoch - 1) * steps_per_epoch + batch
+        self._scalar(f"distance_to_global/{_tag(name)}", dist, step)
 
     def add_round_json(self, **kwargs):
         kwargs.setdefault("time", time.time())
@@ -99,6 +165,12 @@ class Recorder:
             write("weight_result.csv", None, self.weight_result)
         if self.scale_result:
             write("scale_result.csv", None, self.scale_result)
+        if self.batch_loss_result:
+            write("train_batch_result.csv", BATCH_HEADER,
+                  self.batch_loss_result)
+        if self.batch_distance_result:
+            write("distance_result.csv", BATCH_HEADER,
+                  self.batch_distance_result)
         if is_poison:
             write("posiontest_result.csv", TEST_HEADER,
                   self.posiontest_result)

