@@ -95,6 +95,19 @@ _DEFAULTS: Dict[str, Any] = {
     "synthetic_test_size": 0,      # 0 = backend default
     "num_devices": 0,              # 0 = use all visible devices on the clients mesh
     "run_dir": "./runs",
+    "checkpoint_dir": "saved_models",  # root for resume/pretrain checkpoints
+    "dynamic_steps": False,        # size each round's batch plan to the
+                                   # round's own max client (bucketed to limit
+                                   # recompiles) instead of the global max;
+                                   # identical numerics (padding steps are
+                                   # fully-masked no-ops)
+    "pipeline_rounds": False,      # overlap round N's host fetch with round
+                                   # N+1's device compute in Experiment.run
+    "fused_updates": "auto",       # fused pallas per-step state update;
+                                   # auto = on for unsharded TPU runs
+    "fused_interpret": False,      # run the fused kernels in pallas
+                                   # interpret mode (CPU testing)
+
 }
 
 

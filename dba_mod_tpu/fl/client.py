@@ -38,8 +38,9 @@ import jax.numpy as jnp
 from dba_mod_tpu.models import ModelDef, ModelVars
 from dba_mod_tpu.fl.device_data import DeviceData
 from dba_mod_tpu.fl.state import ClientTask, RoundHyper
+from dba_mod_tpu.ops.fused_update import make_fused_step_update
 from dba_mod_tpu.ops.losses import cross_entropy, tree_dist_norm
-from dba_mod_tpu.ops.sgd import sgd_init, sgd_step
+from dba_mod_tpu.ops.sgd import sgd_init
 
 
 class ClientMetrics(NamedTuple):
@@ -66,10 +67,17 @@ def _select_tree(pred, new, old):
 
 
 def make_client_step(model_def: ModelDef, data: DeviceData,
-                     hyper: RoundHyper, fg_enabled: bool):
+                     hyper: RoundHyper, fg_enabled: bool,
+                     fused_pallas: bool = False,
+                     fused_interpret: bool = False):
     """Returns client_step(start_vars, task_row, idx[E,S,B], mask[E,S,B],
     rng) -> SegmentResult, suitable for vmap over (start_vars, task_row, idx,
-    mask, rng)."""
+    mask, rng). `fused_pallas` routes the per-step state update through the
+    fused multi-tensor kernel (ops/fused_update.py) when the engine runs
+    unsharded on TPU; the math is identical either way."""
+    fused_update = make_fused_step_update(
+        hyper.momentum, hyper.weight_decay, fg_enabled,
+        use_pallas=fused_pallas, interpret=fused_interpret)
 
     def client_step(start_vars: ModelVars, benign_mom: Any, task: ClientTask,
                     idx, mask, rng) -> SegmentResult:
@@ -93,7 +101,12 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
             x, y = data.fetch_train(task.slot, bidx)
             x, y, sel = data.stamp(x, y, task.adv_index,
                                    task.poisoning_per_batch)
-            step_rng = jax.random.fold_in(rng, step_i)
+            # derive from (epoch, step-within-epoch), NOT the flat index:
+            # the flat index depends on the plan width S, and dynamic_steps
+            # (experiment.py) shrinks S per round — dropout streams must not
+            # change with the padding
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(rng, e), step_i - e * S)
 
             def loss_fn(p):
                 logits, new_bn = model_def.apply(
@@ -106,17 +119,13 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
             (loss, (logits, new_bn)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             lr = task.lr_row[e]
-            new_params, new_mom = sgd_step(params, grads, mom, lr,
-                                           hyper.momentum, hyper.weight_decay)
             # Padded steps (mask all-false: epochs beyond this client's count,
-            # or steps beyond its batches) must be no-ops.
+            # or steps beyond its batches) must be no-ops; the fused op does
+            # torch-SGD + the validity selects (+ FoolsGold accumulation)
+            # over the whole state in one logical op.
             valid = jnp.sum(bmask) > 0
-            params = _select_tree(valid, new_params, params)
-            bn = _select_tree(valid, new_bn, bn)
-            mom = _select_tree(valid, new_mom, mom)
-            if fg_enabled:
-                fg = _select_tree(
-                    valid, jax.tree_util.tree_map(jnp.add, fg, grads), fg)
+            params, mom, fg, bn = fused_update(lr, valid, params, grads,
+                                               mom, fg, new_bn, bn)
 
             preds = jnp.argmax(logits, axis=-1)
             bmaskf = bmask.astype(jnp.float32)

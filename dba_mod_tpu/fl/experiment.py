@@ -8,6 +8,7 @@ cycles, no global mutable state (SURVEY §1 layer-crossing notes, §7.3).
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import random
 import time
@@ -54,8 +55,27 @@ def _pad_tasks(tasks, pad: int, epochs_max: int):
         num_epochs=np.pad(tasks.num_epochs, (0, pad)))
 
 
+@dataclasses.dataclass
+class RoundInFlight:
+    """Device handles + host context of a dispatched round, awaiting its one
+    blocking transfer. Produced by `dispatch_round`, consumed by
+    `finalize_round`; holding two of these pipelines round N+1's compute
+    behind round N's host fetch (the tunnel round-trip is ~100 ms — hiding it
+    is worth ~10% of a bench round)."""
+    epoch: int
+    t0: float
+    seg_epochs: List[int]
+    agent_names: List[Any]
+    adv_names: List[Any]
+    tasks_list: List[Any]
+    mask_list: List[Any]
+    payload: Any                 # device trees handed to jax.device_get
+
+
 class Experiment:
     def __init__(self, params: cfg.Params, save_results: bool = True):
+        from dba_mod_tpu.parallel.distributed import initialize_distributed
+        initialize_distributed()  # env-triggered; no-op single-host
         self.params = params
         self.folder: Optional[Path] = (params.make_run_folder()
                                        if save_results else None)
@@ -92,7 +112,8 @@ class Experiment:
         self.global_vars = self.model_def.init_vars(init_rng)
         self.start_epoch = 1
         if params["resumed_model"]:
-            path = Path("saved_models") / str(params["resumed_model_name"])
+            path = (Path(str(params.get("checkpoint_dir", "saved_models")))
+                    / str(params["resumed_model_name"]))
             self.global_vars, saved_epoch, saved_lr = ckpt.load_checkpoint(
                 path, self.global_vars)
             self.start_epoch = saved_epoch + 1
@@ -121,6 +142,15 @@ class Experiment:
             self.model_def.similarity_param(self.global_vars.params).shape))
         self.fg_state = foolsgold_init(self.num_participants, grad_len)
         self.local_eval = bool(params.get("local_eval", True))
+        self.last_is_updated = True  # set per-round in finalize_round
+        # Per-round step-count bucketing: the static plan pads every client to
+        # the GLOBAL max client size; a round of 10 sampled clients usually
+        # needs far fewer steps, and masked padding steps cost full compute.
+        # dynamic_steps sizes the plan to the round's own max, quantized to
+        # multiples of _STEP_BUCKET so the jitted round compiles a handful of
+        # shapes instead of one-per-round. Identical numerics: dropped steps
+        # were fully-masked no-ops (tests/test_fl_integration.py).
+        self.dynamic_steps = bool(params.get("dynamic_steps", False))
 
     # ------------------------------------------------------------------ data
     def _load_data_and_partition(self, seed: int):
@@ -212,7 +242,94 @@ class Experiment:
                 poison_mask=jnp.asarray(plan.mask))
 
     # ----------------------------------------------------------------- round
+    _STEP_BUCKET = 2       # quantum of the per-round step-count buckets
+    _STEP_BUCKET_MIN = 8   # floor: tiny rounds share one shape
+
+    def _bucket_steps(self, s: int) -> int:
+        b = self._STEP_BUCKET
+        s = max(((s + b - 1) // b) * b, self._STEP_BUCKET_MIN)
+        return min(s, max(self.steps_per_epoch, 1))
+
+    def warm_step_buckets(self) -> List[int]:
+        """Pre-compile the round program for every step bucket (all-masked
+        zero plans → the compile is shape-driven only). Keeps dynamic_steps
+        rounds from hitting a fresh XLA compile mid-run."""
+        if not self.dynamic_steps:
+            return []
+        buckets = sorted({self._bucket_steps(s) for s in
+                          range(1, self.steps_per_epoch + 1)})
+        names = self.participants[:int(self.params["no_models"])]
+        slots = np.array([self.client_slots[n] for n in names], np.int64)
+        tasks = build_client_tasks(self.params, names, 1, slots,
+                                   self.epochs_max, None)
+        C, E, B = len(names), self.epochs_max, int(self.params["batch_size"])
+        if self.mesh is not None:
+            # match dispatch_round's inert-client padding, or the warm
+            # shapes won't be the shapes real rounds compile
+            from dba_mod_tpu.parallel.mesh import pad_clients
+            c_pad = pad_clients(C, self.mesh)
+            if c_pad != C:
+                tasks = _pad_tasks(tasks, c_pad - C, self.epochs_max)
+                C = c_pad
+        I = self.interval  # real rounds stack one segment per interval epoch
+        for s in buckets:
+            tasks_seq = jax.tree_util.tree_map(
+                lambda l: jnp.asarray(np.stack([l] * I)), tasks)
+            idx = jnp.zeros((I, C, E, s, B), jnp.int32)
+            mask = jnp.zeros((I, C, E, s, B), bool)
+            lane = jnp.arange(C, dtype=jnp.int32)
+            if self.mesh is not None:
+                from dba_mod_tpu.parallel.mesh import shard_round_inputs
+                tasks_seq, idx, mask, _ = shard_round_inputs(
+                    self.mesh, tasks_seq, idx, mask,
+                    jnp.zeros((C,), jnp.float32))
+            for attempt in (1, 2):
+                try:
+                    self.engine.train_fn(self.global_vars, tasks_seq, idx,
+                                         mask, lane, jax.random.key(0))
+                    break
+                except Exception:  # noqa: BLE001 — remote-compile RPCs can
+                    if attempt == 2:  # drop; missing a warm shape only means
+                        logger.warning(  # a compile-on-first-use later
+                            "warm_step_buckets: compile for S=%d failed "
+                            "twice; will compile on first use", s)
+        return buckets
+
+    def build_static_round_inputs(self, epoch: int):
+        """Device-ready train_fn inputs at the STATIC plan shape — for
+        diagnostics that call the engine directly (bench.py's phase probe).
+        Consumes the experiment's selection/plan RNG streams. Returns
+        (tasks_seq, idx_seq, mask_seq, num_samples, lane)."""
+        params = self.params
+        agent_names, _ = select_agents(params, epoch, self.participants,
+                                       self.benign_names, self.select_rng)
+        slots = np.array([self.client_slots[n] for n in agent_names],
+                         np.int64)
+        tasks = build_client_tasks(params, agent_names, epoch, slots,
+                                   self.epochs_max, None)
+        plan = build_batch_plan(
+            [self.client_indices[n] for n in agent_names],
+            [int(e) for e in tasks.num_epochs], int(params["batch_size"]),
+            self.plan_rng, min_steps=self.steps_per_epoch,
+            min_epochs=self.epochs_max)
+        tasks_seq = jax.tree_util.tree_map(lambda l: jnp.asarray(l[None]),
+                                           tasks)
+        return (tasks_seq, jnp.asarray(plan.idx[None]),
+                jnp.asarray(plan.mask[None]),
+                jnp.asarray(plan.num_samples.astype(np.float32)),
+                jnp.arange(len(agent_names), dtype=jnp.int32))
+
     def run_round(self, epoch: int) -> Dict[str, Any]:
+        return self.finalize_round(self.dispatch_round(epoch))
+
+    def dispatch_round(self, epoch: int) -> RoundInFlight:
+        """Host-side planning + every device dispatch for one round; no host
+        sync — EXCEPT the LOAN adaptive-poison probe below, which must read
+        the current global model's backdoor accuracy (loan_train.py:67-75)
+        and therefore blocks on all previously dispatched work (pipelining
+        degrades to sequential for those rounds, by necessity). The returned
+        handle feeds `finalize_round`, which performs the round's single
+        blocking transfer and the CSV/JSONL recording."""
         params = self.params
         t0 = time.time()
         agent_names, adv_names = select_agents(
@@ -235,6 +352,14 @@ class Experiment:
         # (image_train.py:50: the local model trains continuously across the
         # interval; the server applies the summed update once)
         seg_epochs = list(range(epoch, epoch + self.interval))
+        if self.dynamic_steps:
+            b = int(params["batch_size"])
+            round_max = max((len(self.client_indices[n])
+                             for n in agent_names), default=1)
+            min_steps = self._bucket_steps(
+                max(1, int(np.ceil(round_max / b))))
+        else:
+            min_steps = self.steps_per_epoch
         tasks_list, idx_list, mask_list = [], [], []
         num_samples_np = None
         for ep in seg_epochs:
@@ -244,7 +369,7 @@ class Experiment:
                 [self.client_indices[n] for n in agent_names],
                 [int(e) for e in tasks_s.num_epochs],
                 int(params["batch_size"]), self.plan_rng,
-                min_steps=self.steps_per_epoch, min_epochs=self.epochs_max)
+                min_steps=min_steps, min_epochs=self.epochs_max)
             if num_samples_np is None:
                 num_samples_np = plan.num_samples.astype(np.float32)
             tasks_list.append(tasks_s)
@@ -295,30 +420,41 @@ class Experiment:
             self.global_vars, self.fg_state, train.deltas, train.fg_grads,
             train.fg_feature, tasks_first.participant_id, ns_dev, rng_agg)
 
-        # dispatch every eval before any host sync — one blocking transfer
+        # dispatch every eval before any host sync — one blocking transfer,
+        # deferred to finalize_round so a caller can overlap the next round
         locals_dev = (self.engine.local_evals_fn(
             self.global_vars, train.deltas, tasks_last)
             if self.local_eval else None)
+        seg_locals_dev = None
+        if self.local_eval and self.engine.seg_local_evals_fn is not None:
+            seg_locals_dev = self.engine.seg_local_evals_fn(
+                self.global_vars, train.seg_deltas, tasks_seq.scale)
         globals_dev = self.engine.global_evals_fn(result.new_vars)
         self.global_vars = result.new_vars
         self.fg_state = result.new_fg_state
         track = (bool(params.get("vis_train_batch_loss"))
                  or bool(params.get("batch_track_distance")))
         batch_dev = (train.batch_loss, train.batch_dist) if track else None
-        (locals_, globals_, metrics, delta_norms, wv, alpha,
-         batches, is_updated) = jax.device_get(
-            (locals_dev, globals_dev, train.metrics, train.delta_norms,
-             result.wv, result.alpha, batch_dev, result.is_updated))
-        self.last_is_updated = bool(is_updated)
+        payload = (locals_dev, globals_dev, train.metrics, train.delta_norms,
+                   result.wv, result.alpha, batch_dev, result.is_updated,
+                   seg_locals_dev)
+        return RoundInFlight(epoch=epoch, t0=t0, seg_epochs=seg_epochs,
+                             agent_names=agent_names, adv_names=adv_names,
+                             tasks_list=tasks_list, mask_list=mask_list,
+                             payload=payload)
 
-        self._record(epoch, seg_epochs, agent_names, adv_names, tasks_list,
-                     metrics, locals_, globals_, delta_norms, wv, alpha, t0,
-                     batches, mask_list)
-        return {"epoch": epoch, "agents": agent_names,
+    def finalize_round(self, fl: RoundInFlight) -> Dict[str, Any]:
+        (locals_, globals_, metrics, delta_norms, wv, alpha,
+         batches, is_updated, seg_locals) = jax.device_get(fl.payload)
+        self.last_is_updated = bool(is_updated)
+        self._record(fl.epoch, fl.seg_epochs, fl.agent_names, fl.adv_names,
+                     fl.tasks_list, metrics, locals_, globals_, delta_norms,
+                     wv, alpha, fl.t0, batches, fl.mask_list, seg_locals)
+        return {"epoch": fl.epoch, "agents": fl.agent_names,
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
                                  if self.is_poison_run else None),
-                "round_time": time.time() - t0}
+                "round_time": time.time() - fl.t0}
 
     def _train_sequential(self, tasks_seq, idx_seq, mask_seq, rng):
         """Sequential debug mode (SURVEY §7.2.4): run clients one at a time
@@ -335,6 +471,7 @@ class Experiment:
                 mask_seq[:, c:c + 1], jnp.asarray([c], jnp.int32), rng))
         cat0 = lambda *ls: jnp.concatenate(ls, axis=0)
         cat1 = lambda *ls: jnp.concatenate(ls, axis=1)
+        n_seg_deltas = len(outs[0].seg_deltas)
         return TrainResult(
             deltas=jax.tree_util.tree_map(cat0, *[o.deltas for o in outs]),
             fg_grads=jax.tree_util.tree_map(cat0,
@@ -344,19 +481,27 @@ class Experiment:
                                            *[o.metrics for o in outs]),
             delta_norms=jnp.concatenate([o.delta_norms for o in outs], 0),
             batch_loss=jnp.concatenate([o.batch_loss for o in outs], 1),
-            batch_dist=jnp.concatenate([o.batch_dist for o in outs], 1))
+            batch_dist=jnp.concatenate([o.batch_dist for o in outs], 1),
+            seg_deltas=[jax.tree_util.tree_map(
+                cat0, *[o.seg_deltas[s] for o in outs])
+                for s in range(n_seg_deltas)])
 
     # ------------------------------------------------------------- recording
     def _record(self, epoch, seg_epochs, agent_names, adv_names, tasks_list,
                 metrics, locals_, globals_, delta_norms, wv, alpha, t0,
-                batches=None, mask_list=None):
+                batches=None, mask_list=None, seg_locals=None):
         # metrics leaves are [I, C, E]; tasks_list one ClientTask per segment.
-        # Local evals cover the round-final state; for interval > 1 the
-        # reference also evaluates each intermediate epoch — recorded here
-        # only for the final one (all reference configs use interval 1).
+        # Local clean evals: final segment from locals_, intermediate
+        # segments (interval > 1) from seg_locals — matching the reference's
+        # per-global-epoch cadence (image_train.py:268-271, :150-155). The
+        # poison battery stays round-final: the reference runs it in the
+        # poison branch against the round's submitted update.
         params = self.params
         rec = self.recorder
         tasks = tasks_list[-1]
+        # round-final rows carry the round's LAST global epoch, like the
+        # reference's temp_global_epoch = epoch + interval - 1 (main.py:196)
+        final_ep = seg_epochs[-1]
         # per-client flags hold if ANY segment of the round poisoned
         # (a client may poison at epoch 3 of a (3,4) interval round)
         poisoning_any = np.zeros(len(agent_names), bool)
@@ -379,11 +524,18 @@ class Experiment:
                                   int(metrics.correct[s, c, e]), int(count))
                 if batches is not None:
                     # [I, C, E*S] per-batch channels; only steps whose batch
-                    # mask is non-empty ran (padded epochs/steps are no-ops)
+                    # mask is non-empty ran (padded epochs/steps are no-ops).
+                    # The loss channel is benign-only: the reference calls
+                    # train_batch_vis in the benign branch alone
+                    # (image_train.py:225-228), while distance is tracked in
+                    # both branches (:107-112, :235-240).
                     bloss, bdist = batches
                     S = mask_list[s].shape[2]
                     valid = mask_list[s][c].any(axis=-1).reshape(-1)  # [E*S]
-                    want_loss = bool(params.get("vis_train_batch_loss"))
+                    seg_poisons = (np.asarray(
+                        tasks_list[s].poisoning_per_batch)[c] > 0)
+                    want_loss = (bool(params.get("vis_train_batch_loss"))
+                                 and not seg_poisons)
                     want_dist = bool(params.get("batch_track_distance"))
                     for st in np.nonzero(valid)[0]:
                         e_i, b_i = int(st) // S, int(st) % S
@@ -396,25 +548,42 @@ class Experiment:
                                 name, tle, ep, e_i + 1, b_i, S,
                                 float(bdist[s, c, st]))
             poisoning = bool(poisoning_any[c])
+            # the FINAL segment's clean row gates on that segment's own
+            # poisoning flag (a client may poison epoch 3 of a (3,4) round
+            # and still get its benign epoch-4 row, image_train.py:267-271)
+            final_seg_poisons = bool(
+                np.asarray(tasks_list[-1].poisoning_per_batch)[c] > 0)
             baseline = bool(params["baseline"])
+            if seg_locals is not None:
+                # intermediate-segment clean rows (interval > 1): one per
+                # global epoch, like the reference's in-loop evals
+                for s, seg_ev in enumerate(seg_locals):
+                    seg_poisons = (np.asarray(
+                        tasks_list[s].poisoning_per_batch)[c] > 0)
+                    if seg_poisons and bool(params["baseline"]):
+                        continue  # image_train.py:148-155 gating
+                    rec.add_test(name, seg_epochs[s],
+                                 float(seg_ev.loss[c]), float(seg_ev.acc[c]),
+                                 int(seg_ev.correct[c]),
+                                 int(seg_ev.count[c]))
             if locals_ is not None:
                 lr = locals_
                 # the local clean eval for a poisoning client happens inside
                 # `if not baseline` in the reference (image_train.py:148-155);
                 # benign clients always get one (:267-271)
-                if not (poisoning and baseline):
-                    rec.add_test(name, epoch, float(lr.clean.loss[c]),
+                if not (final_seg_poisons and baseline):
+                    rec.add_test(name, final_ep, float(lr.clean.loss[c]),
                                  float(lr.clean.acc[c]),
                                  int(lr.clean.correct[c]),
                                  int(lr.clean.count[c]))
                 if poisoning and self.is_poison_run:
                     if not baseline:
-                        rec.add_poisontest(name, epoch,
+                        rec.add_poisontest(name, final_ep,
                                            float(lr.poison_pre.loss[c]),
                                            float(lr.poison_pre.acc[c]),
                                            int(lr.poison_pre.correct[c]),
                                            int(lr.poison_pre.count[c]))
-                    rec.add_poisontest(name, epoch,
+                    rec.add_poisontest(name, final_ep,
                                        float(lr.poison_post.loss[c]),
                                        float(lr.poison_post.acc[c]),
                                        int(lr.poison_post.correct[c]),
@@ -422,7 +591,7 @@ class Experiment:
                 if (self.is_poison_run and
                         int(adv_slot_any[c]) >= 0):
                     rec.add_triggertest(
-                        name, f"{name}_trigger", "", epoch,
+                        name, f"{name}_trigger", "", final_ep,
                         float(lr.agent_trigger.loss[c]),
                         float(lr.agent_trigger.acc[c]),
                         int(lr.agent_trigger.correct[c]),
@@ -431,15 +600,15 @@ class Experiment:
                 rec.scale_temp_one_row.extend(
                     [epoch, round(float(delta_norms[c]), 4)])
 
-        rec.add_test("global", epoch, float(globals_.clean.loss),
+        rec.add_test("global", final_ep, float(globals_.clean.loss),
                      float(globals_.clean.acc), int(globals_.clean.correct),
                      int(globals_.clean.count))
         if self.is_poison_run:
             g = globals_
-            rec.add_poisontest("global", epoch, float(g.poison.loss),
+            rec.add_poisontest("global", final_ep, float(g.poison.loss),
                                float(g.poison.acc), int(g.poison.correct),
                                int(g.poison.count))
-            rec.add_triggertest("global", "combine", "", epoch,
+            rec.add_triggertest("global", "combine", "", final_ep,
                                 float(g.poison.loss), float(g.poison.acc),
                                 int(g.poison.correct), int(g.poison.count))
             if params.is_centralized_attack:
@@ -451,7 +620,7 @@ class Experiment:
                          for a in params.adversary_list]
             for j, tname in enumerate(names):
                 rec.add_triggertest(
-                    "global", tname, "", epoch,
+                    "global", tname, "", final_ep,
                     float(g.per_trigger.loss[j]), float(g.per_trigger.acc[j]),
                     int(g.per_trigger.correct[j]),
                     int(g.per_trigger.count[j]))
@@ -488,6 +657,27 @@ class Experiment:
         last: Dict[str, Any] = {}
         end = epochs if epochs is not None else int(self.params["epochs"])
         profile_dir = str(self.params.get("profile_dir", "") or "")
+        # pipeline_rounds: overlap round N's host fetch/record with round
+        # N+1's device compute (depth 1). Skipped when per-epoch checkpoints
+        # or profiling need rounds to complete in program order.
+        if (bool(self.params.get("pipeline_rounds", False))
+                and not profile_dir and not self.params["save_model"]):
+            def finalize_and_log(fl):
+                r = self.finalize_round(fl)
+                logger.info("epoch %d done in %.2fs acc=%.2f backdoor=%s",
+                            r["epoch"], r["round_time"], r["global_acc"],
+                            r["backdoor_acc"])
+                return r
+
+            pending: Optional[RoundInFlight] = None
+            for epoch in range(self.start_epoch, end + 1, self.interval):
+                fl = self.dispatch_round(epoch)
+                if pending is not None:
+                    last = finalize_and_log(pending)
+                pending = fl
+            if pending is not None:
+                last = finalize_and_log(pending)
+            return last
         for epoch in range(self.start_epoch, end + 1, self.interval):
             if profile_dir and epoch == self.start_epoch + self.interval:
                 # trace the first post-compile round (SURVEY §5 tracing row)

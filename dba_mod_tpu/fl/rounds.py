@@ -47,6 +47,13 @@ class TrainResult(NamedTuple):
                                   # vis_train_batch_loss is off)
     batch_dist: jax.Array         # [I, C, E*S] per-batch post-step distance
                                   # (zeros when batch_track_distance is off)
+    seg_deltas: Any               # list (len I-1) of full-state ModelVars
+                                  # [C, ...] cumulative deltas at each
+                                  # INTERMEDIATE segment end — feeds the
+                                  # per-epoch local clean evals when
+                                  # aggr_epoch_interval > 1
+                                  # (image_train.py:268-271 runs inside the
+                                  # global-epoch loop); empty list when I == 1
 
 
 class AggregateResult(NamedTuple):
@@ -105,7 +112,15 @@ class RoundEngine:
         self.num_segments = num_segments
         hyper = self.hyper
         fg_enabled = hyper.aggregation == cfg.AGGR_FOOLSGOLD
-        client_step = make_client_step(model_def, data, hyper, fg_enabled)
+        # fused per-step updates: pallas multi-tensor kernels; sound only
+        # when the clients axis is unsharded (GSPMD cannot partition a
+        # custom call), so the mesh path keeps the per-leaf jnp form
+        fu = params.get("fused_updates", "auto")
+        fused_pallas = bool(fu) if fu != "auto" else (
+            mesh is None and jax.default_backend() == "tpu")
+        client_step = make_client_step(
+            model_def, data, hyper, fg_enabled, fused_pallas=fused_pallas,
+            fused_interpret=bool(params.get("fused_interpret", False)))
         eval_clean = make_eval_fn(model_def, data, poison=False)
         eval_poison = make_eval_fn(model_def, data, poison=True)
         is_poison_run = bool(params["is_poison"])
@@ -124,6 +139,7 @@ class RoundEngine:
                 lambda l: jnp.zeros((C,) + l.shape), global_vars.params)
             seg_metrics = []
             seg_bloss, seg_bdist = [], []
+            seg_deltas = []
             for s in range(n_seg):  # static unroll; n_seg is 1 in practice
                 seg_rng = jax.random.fold_in(rng, s)
                 rngs = jax.vmap(
@@ -139,6 +155,9 @@ class RoundEngine:
                 seg_metrics.append(res.metrics)
                 seg_bloss.append(res.batch_loss)
                 seg_bdist.append(res.batch_dist)
+                if s < n_seg - 1:  # intermediate states feed per-epoch evals
+                    seg_deltas.append(jax.tree_util.tree_map(
+                        lambda e, g: e - g, start, global_vars))
             deltas = jax.tree_util.tree_map(lambda e, g: e - g, start,
                                             global_vars)
             fg_feature = jax.vmap(
@@ -149,7 +168,7 @@ class RoundEngine:
                 lambda d: tree_global_norm(d.params))(deltas)
             return TrainResult(deltas, fg_total, fg_feature, metrics,
                                delta_norms, jnp.stack(seg_bloss),
-                               jnp.stack(seg_bdist))
+                               jnp.stack(seg_bdist), seg_deltas)
 
         def aggregate_fn(global_vars: ModelVars,
                          fg_state: agg.FoolsGoldState, deltas: ModelVars,
@@ -203,7 +222,8 @@ class RoundEngine:
             # in_shardings then reject them at the call boundary.
             out_shard = TrainResult(deltas=cs, fg_grads=cs, fg_feature=cs,
                                     metrics=seg_cs, delta_norms=cs,
-                                    batch_loss=seg_cs, batch_dist=seg_cs)
+                                    batch_loss=seg_cs, batch_dist=seg_cs,
+                                    seg_deltas=[cs] * (num_segments - 1))
             self.train_fn = jax.jit(
                 train_fn, in_shardings=(rep, seg_cs, seg_cs, seg_cs, cs,
                                         rep),
@@ -252,6 +272,48 @@ class RoundEngine:
                               client_sharding(mesh), client_sharding(mesh)))
         else:
             self.local_evals_fn = jax.jit(local_evals)
+
+        # Per-epoch local clean evals for aggr_epoch_interval > 1: the
+        # reference evaluates every client after EVERY global epoch inside
+        # the round (image_train.py:268-271 in the epoch loop; :150-155 in
+        # the poison branch, pre-scaling) — the final segment is covered by
+        # local_evals above, intermediate segments here.
+        def seg_local_evals(global_vars: ModelVars, seg_deltas, scales_seq):
+            outs = []
+            prev = None
+            for s, cur in enumerate(seg_deltas):
+                if prev is None:
+                    prev = jax.tree_util.tree_map(jnp.zeros_like, cur)
+
+                def per_client(cur_d, prev_d, scale):
+                    # live pre-scaling model of this segment: the segment
+                    # anchor (global + prev Δ) plus the unscaled step
+                    state = jax.tree_util.tree_map(
+                        lambda g, p, c: g + p + (c - p) / scale,
+                        global_vars, prev_d, cur_d)
+                    return eval_clean(state, plans.clean_idx,
+                                      plans.clean_slots, plans.clean_mask,
+                                      jnp.int32(-1))
+
+                outs.append(jax.vmap(per_client)(cur, prev, scales_seq[s]))
+                prev = cur
+            return outs
+
+        if num_segments > 1:
+            if mesh is not None:
+                from dba_mod_tpu.parallel.mesh import (
+                    client_sharding, replicated_sharding,
+                    segment_client_sharding)
+                self.seg_local_evals_fn = jax.jit(
+                    seg_local_evals,
+                    in_shardings=(replicated_sharding(mesh),
+                                  [client_sharding(mesh)]
+                                  * (num_segments - 1),
+                                  segment_client_sharding(mesh)))
+            else:
+                self.seg_local_evals_fn = jax.jit(seg_local_evals)
+        else:
+            self.seg_local_evals_fn = None
 
         # Global per-trigger battery (main.py:225-231): centralized mode tests
         # each sub-pattern by index — only when `centralized_test_trigger` is

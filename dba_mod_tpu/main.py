@@ -54,7 +54,7 @@ def _pretrain(args) -> int:
         params.raw["synthetic_data"] = True
     exp = Experiment(params, save_results=False)
     last = exp.run()
-    out = Path("saved_models") / (
+    out = Path(str(params.get("checkpoint_dir", "saved_models"))) / (
         args.out or f"{params.type}_pretrain/model_last.pt.tar.epoch_"
                     f"{params['epochs']}")
     ckpt.save_checkpoint(out, exp.global_vars, int(params["epochs"]),

@@ -18,6 +18,8 @@ import jax
 
 logger = logging.getLogger("dba_mod_tpu")
 
+_initialized = False
+
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
@@ -29,10 +31,13 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     auto-detection. Returns True when a multi-process runtime was set up.
     No-op (False) for the common single-host case.
     """
+    global _initialized
     coordinator_address = (coordinator_address or
                            os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if coordinator_address is None and num_processes is None:
         return False
+    if _initialized:  # idempotent: every Experiment calls this
+        return jax.process_count() > 1
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=(num_processes if num_processes is not None else
@@ -40,6 +45,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         process_id=(process_id if process_id is not None else
                     int(os.environ.get("JAX_PROCESS_ID", "-1"))
                     if "JAX_PROCESS_ID" in os.environ else None))
+    _initialized = True
     logger.info("jax.distributed initialized: process %d/%d, %d local / %d "
                 "global devices", jax.process_index(), jax.process_count(),
                 jax.local_device_count(), jax.device_count())

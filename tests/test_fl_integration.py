@@ -169,6 +169,24 @@ def test_aggr_epoch_interval_two():
     assert {1, 2, 3, 4, 5, 6} <= epochs_seen
 
 
+def test_aggr_interval_per_epoch_local_evals():
+    """interval=2 with local_eval: every global epoch of the round gets a
+    local clean-eval row per client (image_train.py:268-271 runs inside the
+    epoch loop; :150-155 pre-scaling in the poison branch) — not just the
+    round-final state."""
+    cfg_d = dict(POISON, aggr_epoch_interval=2, epochs=4, local_eval=True)
+    e = Experiment(Params.from_dict(cfg_d), save_results=False)
+    e.run_round(3)  # segments: epochs 3 and 4; adversaries 0,1 poison
+    rows = [r for r in e.recorder.test_result if r[0] != "global"]
+    by_epoch = {ep: {r[0] for r in rows if r[1] == ep} for ep in (3, 4)}
+    # intermediate epoch 3 rows exist for every selected client, and the
+    # final epoch 4 rows for every client (baseline=False → no gating)
+    assert len(by_epoch[3]) == 4 and len(by_epoch[4]) == 4
+    # intermediate rows are real evals: finite loss, count = test set size
+    for r in rows:
+        assert np.isfinite(r[2]) and r[5] == 256
+
+
 def test_batch_tracking_channels():
     """vis_train_batch_loss / batch_track_distance (image_train.py:225-245)
     record per-batch loss and post-step distance-to-anchor rows instead of
@@ -179,18 +197,23 @@ def test_batch_tracking_channels():
     e.run_round(3)  # epoch 3: adversary 0 poisons
     rec = e.recorder
     assert rec.batch_loss_result and rec.batch_distance_result
-    # every recorded step of every client appears in both channels
-    assert len(rec.batch_loss_result) == len(rec.batch_distance_result)
-    names = {r[0] for r in rec.batch_loss_result}
-    assert names == set(e.recorder.train_result[0][0] for _ in [0]) | names
+    # distance rows cover every training client (both branches,
+    # image_train.py:107-112, :235-240); the loss channel is benign-only
+    # (:225-228), so poisoning client 0 appears in distance but not loss
+    train_names = {r[0] for r in rec.train_result}
+    assert {r[0] for r in rec.batch_distance_result} == train_names
+    loss_names = {r[0] for r in rec.batch_loss_result}
+    assert loss_names == train_names - {0}
+    assert len(rec.batch_distance_result) > len(rec.batch_loss_result)
     # post-step distance to the anchor is strictly positive after any step
     dists = [r[5] for r in rec.batch_distance_result]
     assert all(d > 0 for d in dists)
     losses = [r[5] for r in rec.batch_loss_result]
     assert np.isfinite(losses).all()
     # per-epoch sums over the batch channel agree with the train rows' loss
-    # accounting (same scan, same masking)
-    row0 = rec.train_result[0]
+    # accounting (same scan, same masking) — pick a benign client's row,
+    # since the loss channel is benign-only
+    row0 = next(r for r in rec.train_result if r[0] != 0)
     client, ep, ie = row0[0], row0[2], row0[3]
     chan = [r[5] for r in rec.batch_loss_result
             if r[0] == client and r[2] == ep and r[3] == ie]
