@@ -56,9 +56,9 @@ class SegmentResult(NamedTuple):
     fg_grads: Any            # grads accumulated THIS segment (params tree)
     metrics: ClientMetrics
     batch_loss: jax.Array    # [E*S] per-batch loss (vis_train_batch_loss,
-                             # image_train.py:225-235); zeros when tracking off
+                             # image_train.py:225-235); [0] when tracking off
     batch_dist: jax.Array    # [E*S] post-step ‖w-w_anchor‖ (batch_track_
-                             # distance, image_train.py:236-245); zeros off
+                             # distance, image_train.py:236-245); [0] off
 
 
 def _select_tree(pred, new, old):
@@ -112,8 +112,15 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
                 logits, new_bn = model_def.apply(
                     ModelVars(p, bn), x, train=True, dropout_rng=step_rng)
                 ce = cross_entropy(logits, y, bmask)
-                dist = tree_dist_norm(p, params0)
-                loss = task.alpha * ce + (1.0 - task.alpha) * dist
+                if hyper.alpha_loss == 1.0:
+                    # every reference config sets alpha_loss=1 — the
+                    # anomaly-evading distance term is identically zero, so
+                    # skip its fwd+bwd (a full extra pass over the params)
+                    # at trace time
+                    loss = ce
+                else:
+                    dist = tree_dist_norm(p, params0)
+                    loss = task.alpha * ce + (1.0 - task.alpha) * dist
                 return loss, (logits, new_bn)
 
             (loss, (logits, new_bn)), grads = jax.value_and_grad(
@@ -142,13 +149,18 @@ def make_client_step(model_def: ModelDef, data: DeviceData,
                 # (image_train.py:238: optimizer.step() precedes it)
                 ys = (vf * loss, vf * tree_dist_norm(params, params0))
             else:
-                ys = (jnp.float32(0), jnp.float32(0))
+                ys = None  # nothing stacked, nothing transferred
             return (params, bn, mom, fg, m), ys
 
         xs = (jnp.arange(E * S), idx.reshape(E * S, B),
               mask.reshape(E * S, B))
-        (params, bn, mom, fg, metrics), (batch_loss, batch_dist) = \
-            jax.lax.scan(step, (params0, bn0, mom0, fg0, metrics0), xs)
+        carry, ys = jax.lax.scan(step, (params0, bn0, mom0, fg0, metrics0),
+                                 xs)
+        (params, bn, mom, fg, metrics) = carry
+        if hyper.track_batches:
+            batch_loss, batch_dist = ys
+        else:  # zero-width channels: shape-compatible, cost-free
+            batch_loss = batch_dist = jnp.zeros((0,), jnp.float32)
         # a poison segment leaves the benign buffers untouched
         benign_mom_out = _select_tree(is_poison_seg, benign_mom, mom)
 

@@ -283,10 +283,14 @@ class Experiment:
                 tasks_seq, idx, mask, _ = shard_round_inputs(
                     self.mesh, tasks_seq, idx, mask,
                     jnp.zeros((C,), jnp.float32))
+            ns = jnp.zeros((C,), jnp.float32)
+            rng_t, rng_a = jax.random.split(jax.random.key(0))
             for attempt in (1, 2):
                 try:
-                    self.engine.train_fn(self.global_vars, tasks_seq, idx,
-                                         mask, lane, jax.random.key(0))
+                    # warm the fused round program — the one real rounds run
+                    self.engine.round_fn(self.global_vars, self.fg_state,
+                                         tasks_seq, idx, mask, lane, ns,
+                                         rng_t, rng_a)
                     break
                 except Exception:  # noqa: BLE001 — remote-compile RPCs can
                     if attempt == 2:  # drop; missing a warm shape only means
@@ -408,12 +412,20 @@ class Experiment:
         self.rng_key, round_key = jax.random.split(self.rng_key)
         rng_train, rng_agg = jax.random.split(round_key)
         lane = jnp.arange(idx_seq.shape[1], dtype=jnp.int32)
-        if self.sequential_debug:
-            train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
-                                           rng_train)
-        else:
-            train = self.engine.train_fn(self.global_vars, tasks_seq,
-                                         idx_seq, mask_seq, lane, rng_train)
+        if not self.sequential_debug:
+            # one program, one dispatch: train → aggregate → evals
+            new_vars, new_fg, payload = self.engine.round_fn(
+                self.global_vars, self.fg_state, tasks_seq, idx_seq,
+                mask_seq, lane, ns_dev, rng_train, rng_agg)
+            self.global_vars = new_vars
+            self.fg_state = new_fg
+            return RoundInFlight(
+                epoch=epoch, t0=t0, seg_epochs=seg_epochs,
+                agent_names=agent_names, adv_names=adv_names,
+                tasks_list=tasks_list, mask_list=mask_list, payload=payload)
+
+        train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
+                                       rng_train)
         tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
         tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
         result = self.engine.aggregate_fn(

@@ -43,10 +43,10 @@ class TrainResult(NamedTuple):
     fg_feature: jax.Array         # [C, L] similarity-layer grad, flattened
     metrics: ClientMetrics        # [I, C, E] per segment/client/epoch
     delta_norms: jax.Array        # [C] ‖Δ_params‖ — scale_result.csv distance
-    batch_loss: jax.Array         # [I, C, E*S] per-batch loss (zeros when
-                                  # vis_train_batch_loss is off)
+    batch_loss: jax.Array         # [I, C, E*S] per-batch loss ([I, C, 0]
+                                  # when vis_train_batch_loss is off)
     batch_dist: jax.Array         # [I, C, E*S] per-batch post-step distance
-                                  # (zeros when batch_track_distance is off)
+                                  # ([I, C, 0] when batch_track_distance off)
     seg_deltas: Any               # list (len I-1) of full-state ModelVars
                                   # [C, ...] cumulative deltas at each
                                   # INTERMEDIATE segment end — feeds the
@@ -361,3 +361,46 @@ class RoundEngine:
             return r.acc
 
         self.backdoor_acc_fn = jax.jit(backdoor_acc)
+
+        # The whole round as ONE program: train → aggregate → local evals →
+        # global evals. One dispatch, no cross-program buffer boundaries
+        # (the separate fns above stay for sequential_debug and for bench
+        # phase diagnostics). Returns (new_vars, new_fg_state, payload) with
+        # payload ordered exactly as Experiment.finalize_round unpacks it.
+        do_local_eval = bool(params.get("local_eval", True))
+
+        def round_fn(global_vars: ModelVars, fg_state, tasks_seq, idx_seq,
+                     mask_seq, lane, num_samples, rng_t, rng_a):
+            train = train_fn(global_vars, tasks_seq, idx_seq, mask_seq,
+                             lane, rng_t)
+            tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
+            tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
+            res = aggregate_fn(global_vars, fg_state, train.deltas,
+                               train.fg_grads, train.fg_feature,
+                               tasks_first.participant_id, num_samples,
+                               rng_a)
+            locals_ = (local_evals(global_vars, train.deltas, tasks_last)
+                       if do_local_eval else None)
+            seg_l = (seg_local_evals(global_vars, train.seg_deltas,
+                                     tasks_seq.scale)
+                     if do_local_eval and num_segments > 1 else None)
+            globals_ = global_evals(res.new_vars)
+            track_pair = ((train.batch_loss, train.batch_dist)
+                          if hyper.track_batches else None)
+            return (res.new_vars, res.new_fg_state,
+                    (locals_, globals_, train.metrics, train.delta_norms,
+                     res.wv, res.alpha, track_pair, res.is_updated, seg_l))
+
+        if mesh is not None:
+            from dba_mod_tpu.parallel.mesh import (client_sharding,
+                                                   replicated_sharding,
+                                                   segment_client_sharding)
+            rep2 = replicated_sharding(mesh)
+            cs2 = client_sharding(mesh)
+            seg_cs2 = segment_client_sharding(mesh)
+            self.round_fn = jax.jit(
+                round_fn,
+                in_shardings=(rep2, rep2, seg_cs2, seg_cs2, seg_cs2, cs2,
+                              cs2, rep2, rep2))
+        else:
+            self.round_fn = jax.jit(round_fn)

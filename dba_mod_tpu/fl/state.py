@@ -55,6 +55,9 @@ class RoundHyper:
     geom_median_maxiter: int
     max_update_norm: float | None = None
     track_batches: bool = False
+    alpha_loss: float = 1.0    # static: 1.0 ⇒ the blended-loss distance
+                               # term is identically zero and its (fwd+bwd)
+                               # compute is skipped at trace time
 
     @classmethod
     def from_params(cls, p: cfg.Params) -> "RoundHyper":
@@ -71,7 +74,8 @@ class RoundHyper:
                    geom_median_maxiter=int(p["geom_median_maxiter"]),
                    max_update_norm=(None if mun is None else float(mun)),
                    track_batches=bool(p.get("vis_train_batch_loss")
-                                      or p.get("batch_track_distance")))
+                                      or p.get("batch_track_distance")),
+                   alpha_loss=float(p["alpha_loss"]))
 
 
 def build_client_tasks(params: cfg.Params, agent_names: list, epoch: int,
