@@ -66,3 +66,59 @@ def make_eval_fn(model_def: ModelDef, data: DeviceData, poison: bool):
                           correct=correct, count=count)
 
     return evaluate
+
+
+def make_stacked_eval_fn(model_def: ModelDef, data: DeviceData, poison: bool,
+                         per_client_trigger: bool = False):
+    """evaluate_stacked(stacked_vars [C, ...], idx[S,B], slots[S,B],
+    mask[S,B], adv) -> EvalResult with [C] leaves.
+
+    The per-client local battery evaluates C client models over ONE shared
+    eval plan — fetching and trigger-stamping each test batch inside a
+    per-client vmap (the naive formulation) gathers and stamps every batch
+    C times. Here the batch fetch (and, unless `per_client_trigger`, the
+    stamp) is hoisted out of the model vmap: one gather per batch, shared
+    by all C models; only the forward passes are batched over clients.
+    Numerics are bit-identical to vmapping :func:`make_eval_fn` — same ops,
+    same per-client accumulation order (tests/test_eval_stacked.py).
+
+    `per_client_trigger=True` is the Mytest_poison_agent_trigger variant
+    (test.py:180-239): `adv` is a [C] array and each client's model is
+    evaluated against its own trigger pattern, so only the stamp stays
+    under the vmap; the fetch is still shared."""
+
+    def evaluate_stacked(stacked_vars: ModelVars, idx, slots, mask,
+                         adv) -> EvalResult:
+        def body(carry, inp):
+            loss_sum, correct, count = carry         # [C] each
+            bidx, bslot, bmask = inp
+            x, y = data.fetch_test(bslot, bidx)      # ONE gather, shared
+            if poison and not per_client_trigger:
+                x, y, _ = data.stamp(x, y, adv, 0, poison_all=True)
+            bmaskf = bmask.astype(jnp.float32)
+
+            def per_model(mv: ModelVars, adv_c):
+                if poison and per_client_trigger:
+                    xx, yy, _ = data.stamp(x, y, adv_c, 0, poison_all=True)
+                else:
+                    xx, yy = x, y
+                logits, _ = model_def.apply(mv, xx, train=False)
+                loss = cross_entropy_sum(logits, yy, bmask)
+                preds = jnp.argmax(logits, axis=-1)
+                return (loss, jnp.sum((preds == yy) * bmaskf),
+                        jnp.sum(bmaskf))
+
+            adv_vec = (adv if per_client_trigger else
+                       jnp.zeros((loss_sum.shape[0],), jnp.int32))
+            dl, dc, dn = jax.vmap(per_model)(stacked_vars, adv_vec)
+            return (loss_sum + dl, correct + dc, count + dn), None
+
+        C = jax.tree_util.tree_leaves(stacked_vars)[0].shape[0]
+        zeros = jnp.zeros((C,), jnp.float32)
+        (loss_sum, correct, count), _ = jax.lax.scan(
+            body, (zeros, zeros, zeros), (idx, slots, mask))
+        safe = jnp.maximum(count, 1.0)
+        return EvalResult(loss=loss_sum / safe, acc=100.0 * correct / safe,
+                          correct=correct, count=count)
+
+    return evaluate_stacked

@@ -141,8 +141,29 @@ class Experiment:
         grad_len = int(np.prod(
             self.model_def.similarity_param(self.global_vars.params).shape))
         self.fg_state = foolsgold_init(self.num_participants, grad_len)
+        if self.mesh is not None:
+            # replicate host-initialized state onto the mesh explicitly —
+            # required on multi-host (device_put cannot span processes), a
+            # no-op-cost placement single-host
+            from dba_mod_tpu.parallel.mesh import replicate_for_mesh
+            self.global_vars = replicate_for_mesh(self.mesh,
+                                                  self.global_vars)
+            self.fg_state = replicate_for_mesh(self.mesh, self.fg_state)
         self.local_eval = bool(params.get("local_eval", True))
         self.last_is_updated = True  # set per-round in finalize_round
+        self.last_global_loss = float("inf")  # feeds the best-val checkpoint
+        self.best_loss = float("inf")         # helper.py:433, main.py:120
+        # stale_poison_probe (flag-gated deviation): the LOAN adaptive
+        # poison-LR probe reads the CURRENT global model's backdoor accuracy
+        # (loan_train.py:67-75), which forces a host sync that serializes
+        # round pipelining on every poison round. With this flag the probe
+        # uses the most recently FINALIZED round's backdoor accuracy
+        # instead — one round stale in sequential runs, two rounds stale
+        # under pipeline_rounds (dispatch of round N precedes finalize of
+        # N-1) — for a quantity the reference itself recomputes mid-loop.
+        self.stale_poison_probe = bool(params.get("stale_poison_probe",
+                                                  False))
+        self.last_backdoor_acc: Optional[float] = None
         # Per-round step-count bucketing: the static plan pads every client to
         # the GLOBAL max client size; a round of 10 sampled clients usually
         # needs far fewer steps, and masked padding steps cost full compute.
@@ -151,6 +172,7 @@ class Experiment:
         # shapes instead of one-per-round. Identical numerics: dropped steps
         # were fully-masked no-ops (tests/test_fl_integration.py).
         self.dynamic_steps = bool(params.get("dynamic_steps", False))
+        self._warmed_buckets: set = set()
 
     # ------------------------------------------------------------------ data
     def _load_data_and_partition(self, seed: int):
@@ -256,6 +278,7 @@ class Experiment:
         rounds from hitting a fresh XLA compile mid-run."""
         if not self.dynamic_steps:
             return []
+        failures: list = []
         buckets = sorted({self._bucket_steps(s) for s in
                           range(1, self.steps_per_epoch + 1)})
         names = self.participants[:int(self.params["no_models"])]
@@ -272,31 +295,44 @@ class Experiment:
                 tasks = _pad_tasks(tasks, c_pad - C, self.epochs_max)
                 C = c_pad
         I = self.interval  # real rounds stack one segment per interval epoch
+        tasks_stacked = jax.tree_util.tree_map(
+            lambda l: jnp.asarray(np.stack([l] * I)), tasks)
+        lane = jnp.arange(C, dtype=jnp.int32)
+        rng_t, rng_a = jax.random.split(jax.random.key(0))
         for s in buckets:
-            tasks_seq = jax.tree_util.tree_map(
-                lambda l: jnp.asarray(np.stack([l] * I)), tasks)
             idx = jnp.zeros((I, C, E, s, B), jnp.int32)
             mask = jnp.zeros((I, C, E, s, B), bool)
-            lane = jnp.arange(C, dtype=jnp.int32)
-            if self.mesh is not None:
-                from dba_mod_tpu.parallel.mesh import shard_round_inputs
-                tasks_seq, idx, mask, _ = shard_round_inputs(
-                    self.mesh, tasks_seq, idx, mask,
-                    jnp.zeros((C,), jnp.float32))
             ns = jnp.zeros((C,), jnp.float32)
-            rng_t, rng_a = jax.random.split(jax.random.key(0))
+            tasks_seq = tasks_stacked
+            if self.mesh is not None:
+                # identical placement to dispatch_round — the warm shapes
+                # AND shardings must be the ones real rounds compile
+                from dba_mod_tpu.parallel.mesh import shard_round_inputs
+                tasks_seq, idx, mask, ns = shard_round_inputs(
+                    self.mesh, tasks_seq, idx, mask, ns)
             for attempt in (1, 2):
                 try:
                     # warm the fused round program — the one real rounds run
                     self.engine.round_fn(self.global_vars, self.fg_state,
                                          tasks_seq, idx, mask, lane, ns,
                                          rng_t, rng_a)
+                    self._warmed_buckets.add(s)
                     break
-                except Exception:  # noqa: BLE001 — remote-compile RPCs can
-                    if attempt == 2:  # drop; missing a warm shape only means
-                        logger.warning(  # a compile-on-first-use later
+                except Exception as exc:  # noqa: BLE001 — the TPU
+                    # remote-compile RPC path throws transient 500s; retry
+                    # once, then record the failure with its cause
+                    if attempt == 2:
+                        failures.append((s, exc))
+                        logger.warning(
                             "warm_step_buckets: compile for S=%d failed "
-                            "twice; will compile on first use", s)
+                            "twice (%r); will compile on first use", s, exc)
+        if buckets and len(failures) == len(buckets):
+            # every bucket failing is not a transient RPC hiccup — it means
+            # the warm shapes (or the round program itself) are broken, and
+            # hiding that would resurface as a crash mid-run, far from here
+            raise RuntimeError(
+                "warm_step_buckets: every step bucket failed to compile; "
+                f"first error: {failures[0][1]!r}") from failures[0][1]
         return buckets
 
     def build_static_round_inputs(self, epoch: int):
@@ -347,8 +383,11 @@ class Experiment:
                         epoch in params.poison_epochs_for(
                             params.adversary_slot_of(n))
                         for n in agent_names)):
-            backdoor_acc = float(self.engine.backdoor_acc_fn(
-                self.global_vars))
+            if self.stale_poison_probe and self.last_backdoor_acc is not None:
+                backdoor_acc = self.last_backdoor_acc  # round N-1's battery
+            else:
+                backdoor_acc = float(self.engine.backdoor_acc_fn(
+                    self.global_vars))
 
         slots = np.array([self.client_slots[n] for n in agent_names],
                          np.int64)
@@ -362,6 +401,13 @@ class Experiment:
                              for n in agent_names), default=1)
             min_steps = self._bucket_steps(
                 max(1, int(np.ceil(round_max / b))))
+            if self._warmed_buckets and min_steps not in self._warmed_buckets:
+                # warm shapes drifting from real round shapes is exactly the
+                # failure warm_step_buckets exists to prevent — be loud
+                logger.warning(
+                    "dispatch_round: step bucket S=%d was not pre-warmed "
+                    "(warmed: %s); this round pays a fresh XLA compile",
+                    min_steps, sorted(self._warmed_buckets))
         else:
             min_steps = self.steps_per_epoch
         tasks_list, idx_list, mask_list = [], [], []
@@ -434,13 +480,16 @@ class Experiment:
 
         # dispatch every eval before any host sync — one blocking transfer,
         # deferred to finalize_round so a caller can overlap the next round
+        prev_deltas = (train.seg_deltas[-1] if train.seg_deltas else
+                       jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
         locals_dev = (self.engine.local_evals_fn(
-            self.global_vars, train.deltas, tasks_last)
+            self.global_vars, train.deltas, tasks_last, prev_deltas)
             if self.local_eval else None)
         seg_locals_dev = None
         if self.local_eval and self.engine.seg_local_evals_fn is not None:
             seg_locals_dev = self.engine.seg_local_evals_fn(
-                self.global_vars, train.seg_deltas, tasks_seq.scale)
+                self.global_vars, train.seg_deltas, tasks_seq.scale,
+                tasks_seq.adv_slot)
         globals_dev = self.engine.global_evals_fn(result.new_vars)
         self.global_vars = result.new_vars
         self.fg_state = result.new_fg_state
@@ -459,6 +508,9 @@ class Experiment:
         (locals_, globals_, metrics, delta_norms, wv, alpha,
          batches, is_updated, seg_locals) = jax.device_get(fl.payload)
         self.last_is_updated = bool(is_updated)
+        self.last_global_loss = float(globals_.clean.loss)
+        if self.is_poison_run:
+            self.last_backdoor_acc = float(globals_.poison.acc)
         self._record(fl.epoch, fl.seg_epochs, fl.agent_names, fl.adv_names,
                      fl.tasks_list, metrics, locals_, globals_, delta_norms,
                      wv, alpha, fl.t0, batches, fl.mask_list, seg_locals)
@@ -567,17 +619,45 @@ class Experiment:
                 np.asarray(tasks_list[-1].poisoning_per_batch)[c] > 0)
             baseline = bool(params["baseline"])
             if seg_locals is not None:
-                # intermediate-segment clean rows (interval > 1): one per
-                # global epoch, like the reference's in-loop evals
+                # intermediate-segment rows (interval > 1): the reference
+                # runs the whole battery inside the per-global-epoch loop —
+                # same gating as the final segment below
                 for s, seg_ev in enumerate(seg_locals):
+                    ep_s = seg_epochs[s]
                     seg_poisons = (np.asarray(
                         tasks_list[s].poisoning_per_batch)[c] > 0)
-                    if seg_poisons and bool(params["baseline"]):
-                        continue  # image_train.py:148-155 gating
-                    rec.add_test(name, seg_epochs[s],
-                                 float(seg_ev.loss[c]), float(seg_ev.acc[c]),
-                                 int(seg_ev.correct[c]),
-                                 int(seg_ev.count[c]))
+                    if not (seg_poisons and baseline):
+                        # image_train.py:148-155 gating
+                        rec.add_test(name, ep_s,
+                                     float(seg_ev.clean.loss[c]),
+                                     float(seg_ev.clean.acc[c]),
+                                     int(seg_ev.clean.correct[c]),
+                                     int(seg_ev.clean.count[c]))
+                    if seg_poisons and self.is_poison_run:
+                        if not baseline:  # pre-scale row (:157-164)
+                            rec.add_poisontest(
+                                name, ep_s,
+                                float(seg_ev.poison_pre.loss[c]),
+                                float(seg_ev.poison_pre.acc[c]),
+                                int(seg_ev.poison_pre.correct[c]),
+                                int(seg_ev.poison_pre.count[c]))
+                        # post-scale row (:275-282)
+                        rec.add_poisontest(
+                            name, ep_s,
+                            float(seg_ev.poison_post.loss[c]),
+                            float(seg_ev.poison_post.acc[c]),
+                            int(seg_ev.poison_post.correct[c]),
+                            int(seg_ev.poison_post.count[c]))
+                    if (self.is_poison_run and int(np.asarray(
+                            tasks_list[s].adv_slot)[c]) >= 0):
+                        # per-agent trigger row runs for every adversary
+                        # every global epoch (:285-295)
+                        rec.add_triggertest(
+                            name, f"{name}_trigger", "", ep_s,
+                            float(seg_ev.agent_trigger.loss[c]),
+                            float(seg_ev.agent_trigger.acc[c]),
+                            int(seg_ev.agent_trigger.correct[c]),
+                            int(seg_ev.agent_trigger.count[c]))
             if locals_ is not None:
                 lr = locals_
                 # the local clean eval for a poisoning client happens inside
@@ -664,6 +744,13 @@ class Experiment:
             ckpt.save_checkpoint(Path(str(path) + f".epoch_{epoch}"),
                                  self.global_vars, epoch,
                                  float(params["lr"]))
+        # best-val snapshot whenever the global eval loss improves
+        # (helper.py:433-435, called with epoch_loss from main.py:233)
+        if self.last_global_loss < self.best_loss:
+            ckpt.save_checkpoint(Path(str(path) + ".best"),
+                                 self.global_vars, epoch,
+                                 float(params["lr"]))
+            self.best_loss = self.last_global_loss
 
     def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
         last: Dict[str, Any] = {}

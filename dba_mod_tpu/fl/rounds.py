@@ -235,33 +235,58 @@ class RoundEngine:
             self.train_fn = jax.jit(train_fn)
             self.aggregate_fn = jax.jit(aggregate_fn)
 
-        def local_evals(global_vars: ModelVars, deltas: ModelVars,
-                        tasks: ClientTask) -> LocalEvals:
-            def per_client(delta: ModelVars, scale, adv_slot):
-                unscaled = jax.tree_util.tree_map(
-                    lambda g, d: g + d / scale, global_vars, delta)
-                scaled = jax.tree_util.tree_map(
-                    lambda g, d: g + d, global_vars, delta)
-                clean = eval_clean(unscaled, plans.clean_idx,
-                                   plans.clean_slots, plans.clean_mask,
-                                   jnp.int32(-1))
-                if is_poison_run:
-                    pre = eval_poison(unscaled, plans.poison_idx,
-                                      plans.poison_slots, plans.poison_mask,
-                                      jnp.int32(-1))
-                    post = eval_poison(scaled, plans.poison_idx,
-                                       plans.poison_slots, plans.poison_mask,
-                                       jnp.int32(-1))
-                    agent = eval_poison(scaled, plans.poison_idx,
-                                        plans.poison_slots, plans.poison_mask,
-                                        adv_slot)
-                else:
-                    zero = EvalResult(*(jnp.float32(0),) * 4)
-                    pre = post = agent = zero
-                return LocalEvals(clean, pre, post, agent)
+        # Stacked local battery: C client models share ONE eval plan, so the
+        # batch fetch + combined-trigger stamp are hoisted out of the model
+        # vmap — one gather per batch instead of C (the naive per-client
+        # vmap gathered and stamped every test batch C times per battery).
+        from dba_mod_tpu.fl.evaluation import make_stacked_eval_fn
+        eval_clean_s = make_stacked_eval_fn(model_def, data, poison=False)
+        eval_poison_s = make_stacked_eval_fn(model_def, data, poison=True)
+        eval_agent_s = make_stacked_eval_fn(model_def, data, poison=True,
+                                            per_client_trigger=True)
 
-            return jax.vmap(per_client, in_axes=(0, 0, 0))(
-                deltas, tasks.scale, tasks.adv_slot)
+        def _bc(s, leaf):
+            """[C] → [C, 1, ...] for per-client scalars against [C, ...]."""
+            return s.reshape((s.shape[0],) + (1,) * (leaf.ndim - 1))
+
+        def _stacked_battery(unscaled: ModelVars, scaled: ModelVars,
+                             adv_slots) -> LocalEvals:
+            """The per-client battery (all leaves [C]): clean on the
+            pre-scaling model (image_train.py:150-155, :268-271), poison pre
+            on it (:157-164), poison post + per-agent trigger on the
+            submitted one (:275-282, :291-295)."""
+            clean = eval_clean_s(unscaled, plans.clean_idx, plans.clean_slots,
+                                 plans.clean_mask, jnp.int32(-1))
+            if is_poison_run:
+                pre = eval_poison_s(unscaled, plans.poison_idx,
+                                    plans.poison_slots, plans.poison_mask,
+                                    jnp.int32(-1))
+                post = eval_poison_s(scaled, plans.poison_idx,
+                                     plans.poison_slots, plans.poison_mask,
+                                     jnp.int32(-1))
+                agent = eval_agent_s(scaled, plans.poison_idx,
+                                     plans.poison_slots, plans.poison_mask,
+                                     adv_slots)
+            else:
+                C = adv_slots.shape[0]
+                zero = EvalResult(*(jnp.zeros((C,), jnp.float32),) * 4)
+                pre = post = agent = zero
+            return LocalEvals(clean, pre, post, agent)
+
+        def local_evals(global_vars: ModelVars, deltas: ModelVars,
+                        tasks: ClientTask,
+                        prev_deltas: ModelVars) -> LocalEvals:
+            # `prev_deltas` anchors the final segment: the pre-scaling model
+            # is (global + prev) + (Δ - prev)/scale — for interval=1 prev is
+            # zero and this reduces to global + Δ/scale; for interval>1 it
+            # divides only the FINAL segment's step by its scale (earlier
+            # segments' contributions were already scaled when submitted)
+            unscaled = jax.tree_util.tree_map(
+                lambda g, p, d: g + p + (d - p) / _bc(tasks.scale, d),
+                global_vars, prev_deltas, deltas)
+            scaled = jax.tree_util.tree_map(lambda g, d: g + d, global_vars,
+                                            deltas)
+            return _stacked_battery(unscaled, scaled, tasks.adv_slot)
 
         if mesh is not None:
             from dba_mod_tpu.parallel.mesh import (client_sharding,
@@ -269,33 +294,34 @@ class RoundEngine:
             self.local_evals_fn = jax.jit(
                 local_evals,
                 in_shardings=(replicated_sharding(mesh),
-                              client_sharding(mesh), client_sharding(mesh)))
+                              client_sharding(mesh), client_sharding(mesh),
+                              client_sharding(mesh)))
         else:
             self.local_evals_fn = jax.jit(local_evals)
 
-        # Per-epoch local clean evals for aggr_epoch_interval > 1: the
-        # reference evaluates every client after EVERY global epoch inside
-        # the round (image_train.py:268-271 in the epoch loop; :150-155 in
-        # the poison branch, pre-scaling) — the final segment is covered by
-        # local_evals above, intermediate segments here.
-        def seg_local_evals(global_vars: ModelVars, seg_deltas, scales_seq):
+        # Per-epoch local evals for aggr_epoch_interval > 1: the reference
+        # runs the whole battery inside the per-global-epoch loop — clean +
+        # pre-scaling poison in the poison branch (image_train.py:150-164),
+        # clean for benign epochs (:268-271), post-scaling poison and the
+        # per-agent trigger test (:273-295) — the final segment is covered by
+        # local_evals above, intermediate segments here, with the same
+        # LocalEvals battery per segment.
+        def seg_local_evals(global_vars: ModelVars, seg_deltas, scales_seq,
+                            adv_slots_seq):
             outs = []
             prev = None
             for s, cur in enumerate(seg_deltas):
                 if prev is None:
                     prev = jax.tree_util.tree_map(jnp.zeros_like, cur)
-
-                def per_client(cur_d, prev_d, scale):
-                    # live pre-scaling model of this segment: the segment
-                    # anchor (global + prev Δ) plus the unscaled step
-                    state = jax.tree_util.tree_map(
-                        lambda g, p, c: g + p + (c - p) / scale,
-                        global_vars, prev_d, cur_d)
-                    return eval_clean(state, plans.clean_idx,
-                                      plans.clean_slots, plans.clean_mask,
-                                      jnp.int32(-1))
-
-                outs.append(jax.vmap(per_client)(cur, prev, scales_seq[s]))
+                # live model of this segment: anchor (global + prev Δ) plus
+                # this segment's step, unscaled for the pre rows
+                unscaled = jax.tree_util.tree_map(
+                    lambda g, p, c: g + p + (c - p) / _bc(scales_seq[s], c),
+                    global_vars, prev, cur)
+                scaled = jax.tree_util.tree_map(
+                    lambda g, c: g + c, global_vars, cur)
+                outs.append(_stacked_battery(unscaled, scaled,
+                                             adv_slots_seq[s]))
                 prev = cur
             return outs
 
@@ -309,6 +335,7 @@ class RoundEngine:
                     in_shardings=(replicated_sharding(mesh),
                                   [client_sharding(mesh)]
                                   * (num_segments - 1),
+                                  segment_client_sharding(mesh),
                                   segment_client_sharding(mesh)))
             else:
                 self.seg_local_evals_fn = jax.jit(seg_local_evals)
@@ -379,10 +406,13 @@ class RoundEngine:
                                train.fg_grads, train.fg_feature,
                                tasks_first.participant_id, num_samples,
                                rng_a)
-            locals_ = (local_evals(global_vars, train.deltas, tasks_last)
+            prev = (train.seg_deltas[-1] if num_segments > 1 else
+                    jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
+            locals_ = (local_evals(global_vars, train.deltas, tasks_last,
+                                   prev)
                        if do_local_eval else None)
             seg_l = (seg_local_evals(global_vars, train.seg_deltas,
-                                     tasks_seq.scale)
+                                     tasks_seq.scale, tasks_seq.adv_slot)
                      if do_local_eval and num_segments > 1 else None)
             globals_ = global_evals(res.new_vars)
             track_pair = ((train.batch_loss, train.batch_dist)
@@ -398,9 +428,14 @@ class RoundEngine:
             rep2 = replicated_sharding(mesh)
             cs2 = client_sharding(mesh)
             seg_cs2 = segment_client_sharding(mesh)
+            # out_shardings: the new global/defense state stays replicated
+            # (it feeds the next round's rep in_shardings), and the small
+            # metrics payload is replicated so finalize_round's device_get
+            # is host-local on EVERY process of a multi-host run
             self.round_fn = jax.jit(
                 round_fn,
                 in_shardings=(rep2, rep2, seg_cs2, seg_cs2, seg_cs2, cs2,
-                              cs2, rep2, rep2))
+                              cs2, rep2, rep2),
+                out_shardings=(rep2, rep2, rep2))
         else:
             self.round_fn = jax.jit(round_fn)

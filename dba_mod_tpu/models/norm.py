@@ -1,0 +1,67 @@
+"""Batch normalization with exact torch semantics.
+
+flax.linen.BatchNorm updates the running variance with the BIASED batch
+variance; torch's nn.BatchNorm2d uses the UNBIASED (n/(n-1)) variance for the
+running update while normalizing with the biased one (the train-mode output is
+identical, the running stats differ by the Bessel factor). The reference
+aggregates and evaluates through those running buffers (helper.py:240-257
+averages them with the weights; test.py runs model.eval()), so the buffers are
+part of the model state we must reproduce — this module implements the torch
+rule exactly.
+
+Interface mirrors flax.linen.BatchNorm (same param/collection names: `scale`,
+`bias` in params; `mean`, `var` in batch_stats; flax momentum convention
+ra = momentum·ra + (1-momentum)·batch, so flax momentum 0.9 ≙ torch 0.1).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BatchNorm(nn.Module):
+    """Named `BatchNorm` so flax auto-naming keeps the `BatchNorm_N` param
+    paths (checkpoint/key compatibility with the stock-flax variant);
+    import as `TorchBatchNorm` to make call sites self-documenting."""
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None  # output dtype; statistics always compute in float32
+
+    @nn.compact
+    def __call__(self, x):
+        features = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (features,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (features,),
+                          jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((features,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((features,), jnp.float32))
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32).reshape(-1, features)
+            n = xf.shape[0]
+            mean = jnp.mean(xf, axis=0)
+            # biased variance normalizes the batch (torch train-mode output)
+            var = jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean)
+            if not self.is_initializing():
+                # torch running update uses the UNBIASED variance
+                bessel = n / max(n - 1, 1)
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+                ra_var.value = m * ra_var.value + (1.0 - m) * (var * bessel)
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(
+            var + self.epsilon) * scale + bias
+        return y.astype(self.dtype or x.dtype)
+
+
+TorchBatchNorm = BatchNorm

@@ -11,7 +11,10 @@ Capability parity:
   BN γ=1/β=0 (reference :158-163).
 
 Layout is NHWC, BatchNorm carries running stats in the `batch_stats` collection
-(torch momentum 0.1 ≙ flax momentum 0.9, eps 1e-5). Deeper variants
+(torch momentum 0.1 ≙ flax momentum 0.9, eps 1e-5) with exact torch running-stat
+semantics — `models/norm.py::TorchBatchNorm` updates running_var with the
+UNBIASED batch variance like torch, where flax's BatchNorm uses the biased one
+(proven equivalent in tests/test_parity_ab.py). Deeper variants
 (ResNet-34/50/101/152, reference resnet_cifar.py:106-116) are exposed through the
 same constructors via `num_blocks`/`bottleneck`.
 """
@@ -23,6 +26,7 @@ from typing import Any, Callable, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from dba_mod_tpu.models.norm import TorchBatchNorm
 from dba_mod_tpu.ops.initializers import (kaiming_normal_fan_out,
                                           torch_bias_init,
                                           torch_kaiming_uniform)
@@ -100,7 +104,7 @@ class ResNet(nn.Module):
         x = x.astype(self.dtype)
         conv = partial(nn.Conv, kernel_init=self.kernel_init,
                        dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
+        norm = partial(TorchBatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         block_cls = Bottleneck if self.bottleneck else BasicBlock
 

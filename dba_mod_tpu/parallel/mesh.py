@@ -49,14 +49,47 @@ def segment_client_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, CLIENTS_AXIS))
 
 
+def _place(t, sharding: NamedSharding, clients_axis: int):
+    """Single-controller: plain device_put. Multi-process (DCN): every host
+    holds the full host-side plan (selection/plan RNGs are seeded
+    identically on all hosts), and hands ONLY its addressable slice of the
+    clients axis to `jax.make_array_from_process_local_data` — the per-host
+    input-placement pattern for multi-host SPMD (device_put cannot target
+    non-addressable devices)."""
+    if jax.process_count() == 1:
+        return jax.device_put(t, sharding)
+    t = np.asarray(t)
+    index_map = sharding.addressable_devices_indices_map(t.shape)
+    bounds = [(sl[clients_axis].start or 0,
+               sl[clients_axis].stop if sl[clients_axis].stop is not None
+               else t.shape[clients_axis]) for sl in index_map.values()]
+    lo = min(b[0] for b in bounds)
+    hi = max(b[1] for b in bounds)
+    local = t[(slice(None),) * clients_axis + (slice(lo, hi),)]
+    return jax.make_array_from_process_local_data(sharding, local, t.shape)
+
+
 def shard_round_inputs(mesh: Mesh, tasks_seq: Any, idx_seq, mask_seq,
                        num_samples):
     """Place one round's segment-stacked inputs ([I, C, ...] leaves) with
     clients-axis sharding; num_samples is [C]."""
     seg_cs = segment_client_sharding(mesh)
-    put = lambda t: jax.device_put(t, seg_cs)
+    put = lambda t: _place(t, seg_cs, clients_axis=1)
     return (jax.tree_util.tree_map(put, tasks_seq), put(idx_seq),
-            put(mask_seq), jax.device_put(num_samples, client_sharding(mesh)))
+            put(mask_seq),
+            _place(num_samples, client_sharding(mesh), clients_axis=0))
+
+
+def replicate_for_mesh(mesh: Mesh, tree: Any) -> Any:
+    """Replicate host-side state (global model, defense state) onto the
+    mesh. Multi-process: every host contributes its identical full copy via
+    make_array_from_process_local_data (device_put cannot span processes)."""
+    rep = replicated_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, rep)
+    return jax.tree_util.tree_map(
+        lambda l: jax.make_array_from_process_local_data(
+            rep, np.asarray(l), np.asarray(l).shape), tree)
 
 
 def pad_clients(n_clients: int, mesh: Optional[Mesh]) -> int:

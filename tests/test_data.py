@@ -120,3 +120,22 @@ def test_stack_ragged():
     out = batching.stack_ragged(arrs)
     assert out.shape == (2, 5, 2)
     assert out[0, 3:].sum() == 0
+
+
+def test_poisoning_clients_report_clean_partition_size():
+    """num_samples quirk decision (README quirk table): the reference's
+    poison branch iterates the SAME per-client loader as the benign branch
+    (image_train.py:72 reuses helper.train_data[agent]; LOAN's
+    get_poison_trainloader returns the full state shard,
+    loan_helper.py:56-61), so the `dataset_size` it reports into
+    num_samples_dict (image_train.py:137) EQUALS the clean partition size.
+    build_batch_plan.num_samples — which feeds RFA's Weiszfeld alphas
+    (helper.py:303,316) — must therefore be the clean partition size for
+    poisoning and benign clients alike."""
+    rng = np.random.RandomState(0)
+    indices = [list(range(37)), list(range(100, 153)), list(range(200, 212))]
+    # poisoning client 0 trains more epochs than the benign ones — the
+    # reported size must not depend on the epoch count or poison status
+    plan = batching.build_batch_plan(indices, [6, 2, 2], batch_size=8,
+                                     rng=rng, min_steps=7, min_epochs=6)
+    np.testing.assert_array_equal(plan.num_samples, [37, 53, 12])

@@ -170,10 +170,12 @@ def test_aggr_epoch_interval_two():
 
 
 def test_aggr_interval_per_epoch_local_evals():
-    """interval=2 with local_eval: every global epoch of the round gets a
-    local clean-eval row per client (image_train.py:268-271 runs inside the
-    epoch loop; :150-155 pre-scaling in the poison branch) — not just the
-    round-final state."""
+    """interval=2 with local_eval: every global epoch of the round gets the
+    FULL local battery per client — clean rows (image_train.py:268-271 in
+    the epoch loop; :150-155 pre-scaling in the poison branch), poisontest
+    pre+post rows for poisoning epochs (:157-164, :275-282), and per-agent
+    trigger rows for adversaries (:285-295) — not just the round-final
+    state."""
     cfg_d = dict(POISON, aggr_epoch_interval=2, epochs=4, local_eval=True)
     e = Experiment(Params.from_dict(cfg_d), save_results=False)
     e.run_round(3)  # segments: epochs 3 and 4; adversaries 0,1 poison
@@ -185,6 +187,17 @@ def test_aggr_interval_per_epoch_local_evals():
     # intermediate rows are real evals: finite loss, count = test set size
     for r in rows:
         assert np.isfinite(r[2]) and r[5] == 256
+    # adversary 0 poisons BOTH epochs 3 and 4 → posiontest pre+post rows at
+    # each epoch (intermediate battery, not just round-final)
+    p_rows = [r for r in e.recorder.posiontest_result if r[0] == 0]
+    assert len([r for r in p_rows if r[1] == 3]) == 2
+    assert len([r for r in p_rows if r[1] == 4]) == 2
+    # per-agent trigger rows exist for both epochs of the round
+    trig_eps = {r[3] for r in e.recorder.poisontriggertest_result
+                if r[0] == 0}
+    assert {3, 4} <= trig_eps
+    for r in e.recorder.posiontest_result:
+        assert np.isfinite(r[2])
 
 
 def test_batch_tracking_channels():

@@ -1,0 +1,50 @@
+"""TorchBatchNorm semantics: train-mode output, UNBIASED running_var update
+(the rule flax's stock BatchNorm gets wrong vs torch), eval-mode stats."""
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from dba_mod_tpu.models.norm import TorchBatchNorm
+
+
+def _mk(rng):
+    tbn = torch.nn.BatchNorm2d(6, eps=1e-5, momentum=0.1)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.tensor(rng.randn(6).astype(np.float32)))
+        tbn.bias.copy_(torch.tensor(rng.randn(6).astype(np.float32)))
+        tbn.running_mean.copy_(torch.tensor(rng.randn(6).astype(np.float32)))
+        tbn.running_var.copy_(
+            torch.tensor((rng.rand(6) + 0.5).astype(np.float32)))
+    variables = {
+        "params": {"scale": jnp.asarray(tbn.weight.detach().numpy()),
+                   "bias": jnp.asarray(tbn.bias.detach().numpy())},
+        "batch_stats": {"mean": jnp.asarray(tbn.running_mean.numpy().copy()),
+                        "var": jnp.asarray(tbn.running_var.numpy().copy())}}
+    return tbn, variables
+
+
+def test_train_output_and_unbiased_running_update_match_torch():
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 5, 5, 6) * 2 + 0.5).astype(np.float32)
+    tbn, variables = _mk(rng)
+    y, upd = TorchBatchNorm(use_running_average=False).apply(
+        variables, jnp.asarray(x), mutable=["batch_stats"])
+    tbn.train()
+    ty = tbn(torch.tensor(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(upd["batch_stats"]["mean"]),
+                               tbn.running_mean.numpy(), atol=1e-6)
+    # torch updates running_var with the n/(n-1) UNBIASED batch variance
+    np.testing.assert_allclose(np.asarray(upd["batch_stats"]["var"]),
+                               tbn.running_var.numpy(), rtol=1e-5)
+
+
+def test_eval_mode_uses_running_stats():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 2, 2, 6).astype(np.float32)
+    tbn, variables = _mk(rng)
+    y = TorchBatchNorm(use_running_average=True).apply(variables,
+                                                      jnp.asarray(x))
+    tbn.eval()
+    ty = tbn(torch.tensor(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(), atol=1e-5)

@@ -27,10 +27,21 @@ def test_mesh_matches_single_device():
     e8 = Experiment(Params.from_dict(dict(BASE, num_devices=8)),
                     save_results=False)
     assert e8.mesh is not None and e8.mesh.devices.size == 8
-    for i in range(1, 4):
+    r1 = e1.run_round(1)
+    r8 = e8.run_round(1)
+    # ROUND 1 is tight: per-client training is device-local and
+    # bit-identical; the two programs differ only in the FedAvg reduction
+    # order (psum tree vs flat sum) — last-ulp noise through one round.
+    l1 = jax.tree_util.tree_leaves(e1.global_vars.params)[0]
+    l8 = jax.tree_util.tree_leaves(e8.global_vars.params)[0]
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l8), atol=1e-5)
+    assert abs(r1["global_acc"] - r8["global_acc"]) < 0.5
+    for i in range(2, 4):
         r1 = e1.run_round(i)
         r8 = e8.run_round(i)
-    # identical seeds → identical rounds up to reduction-order noise
+    # Later rounds amplify that ulp-level seed chaotically through ReLU
+    # boundaries (the same measured behavior as the cross-framework A/B,
+    # PARITY_AB.md) → drift envelope + the accuracy bound, not bit equality.
     assert abs(r1["global_acc"] - r8["global_acc"]) < 1.0
     assert abs(r1["backdoor_acc"] - r8["backdoor_acc"]) < 2.0
     l1 = jax.tree_util.tree_leaves(e1.global_vars.params)[0]

@@ -1,0 +1,109 @@
+"""Mesh coverage for the flagship paths (VERDICT r3 ask 3): CIFAR-BN rounds,
+FoolsGold, and RFA on the 8-device clients mesh must reproduce single-device
+numerics — batch_stats trees through GSPMD, the FoolsGold [C, L] feature
+all-gather + participant-id memory scatter, and RFA's per-iteration distance
+collectives all run sharded here.
+
+Tolerance rationale (VERDICT r3 ask 8): after ONE round the only difference
+between the mesh and single-device programs is collective reduction order
+(per-client training is device-local and bit-identical), so round-1
+comparisons are tight. Over multiple rounds those last-ulp differences are
+amplified chaotically through ReLU boundaries — the same measured behavior
+as the cross-framework A/B (PARITY_AB.md) — so multi-round comparisons use
+a drift envelope plus the accuracy bound."""
+import numpy as np
+import pytest
+
+import jax
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.experiment import Experiment
+
+MNIST8 = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=4, no_models=8,
+    number_of_total_participants=16, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, internal_poison_epochs=2, is_poison=True,
+    synthetic_data=True, synthetic_train_size=640, synthetic_test_size=256,
+    momentum=0.9, decay=0.0005, sampling_dirichlet=False, local_eval=False,
+    poison_label_swap=2, poisoning_per_batch=8, poison_lr=0.05,
+    scale_weights_poison=3.0, adversary_list=[0], trigger_num=1,
+    alpha_loss=1.0, random_seed=1,
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2], [0, 3]],
+       "0_poison_epochs": [1, 2, 3]})
+
+CIFAR8 = dict(
+    type="cifar", lr=0.1, batch_size=8, epochs=2, no_models=8,
+    number_of_total_participants=8, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, internal_poison_epochs=1, is_poison=True,
+    synthetic_data=True, synthetic_train_size=128, synthetic_test_size=128,
+    momentum=0.9, decay=0.0005, sampling_dirichlet=False, local_eval=True,
+    poison_label_swap=2, poisoning_per_batch=4, poison_lr=0.05,
+    scale_weights_poison=2.0, adversary_list=[0], trigger_num=1,
+    alpha_loss=1.0, random_seed=1,
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2]],
+       "0_poison_epochs": [1, 2]})
+
+
+def _pair(cfg):
+    e1 = Experiment(Params.from_dict(cfg), save_results=False)
+    e8 = Experiment(Params.from_dict(dict(cfg, num_devices=8)),
+                    save_results=False)
+    assert e8.mesh is not None and e8.mesh.devices.size == 8
+    return e1, e8
+
+
+def _flat(tree):
+    return np.concatenate([np.asarray(l, np.float64).ravel()
+                           for l in jax.tree_util.tree_leaves(tree)])
+
+
+def test_cifar_bn_round_on_mesh_matches_single_device():
+    """The flagship model (BN ResNet) with the full local battery, sharded:
+    batch_stats trees flow through the GSPMD round; one round is tight."""
+    e1, e8 = _pair(CIFAR8)
+    r1 = e1.run_round(1)
+    r8 = e8.run_round(1)
+    assert np.isfinite(r8["global_acc"])
+    # Unlike the MNIST case, the BN ResNet cannot be near-bit here: sharding
+    # changes the per-device client-batch (8 clients on one device vs 1 per
+    # device), so XLA compiles different conv kernels whose f32 summation
+    # orders differ at ~1e-6 — and any activation inside that band of zero
+    # flips its ReLU gate (the same measured chaos as the cross-framework
+    # A/B, tests/test_parity_ab.py::test_cifar_bn_ab_parity). Envelope on
+    # state, tight-ish bar on accuracy (128-sample eval ⇒ 0.8% per sample).
+    np.testing.assert_allclose(_flat(e1.global_vars.params),
+                               _flat(e8.global_vars.params), atol=5e-3)
+    np.testing.assert_allclose(_flat(e1.global_vars.batch_stats),
+                               _flat(e8.global_vars.batch_stats), atol=5e-3)
+    assert abs(r1["global_acc"] - r8["global_acc"]) < 3.0
+    assert abs(r1["backdoor_acc"] - r8["backdoor_acc"]) < 3.0
+    # the sharded local battery produced rows for every client
+    assert len({row[0] for row in e8.recorder.test_result
+                if row[0] != "global"}) == 8
+
+
+@pytest.mark.parametrize("method", ["foolsgold", "geom_median"])
+def test_defenses_on_mesh_match_single_device(method):
+    """FoolsGold (feature all-gather + id-keyed memory scatter) and RFA
+    (Weiszfeld distance collectives) over the sharded clients axis."""
+    cfg = dict(MNIST8, aggregation_methods=method)
+    e1, e8 = _pair(cfg)
+    r1 = e1.run_round(1)
+    r8 = e8.run_round(1)
+    assert np.isfinite(r8["global_acc"])
+    np.testing.assert_allclose(_flat(e1.global_vars.params),
+                               _flat(e8.global_vars.params), atol=1e-4)
+    # defense weight/alpha rows agree per client
+    w1 = e1.recorder.weight_result
+    w8 = e8.recorder.weight_result
+    assert w1[0] == w8[0]                      # same client names
+    np.testing.assert_allclose(w1[1], w8[1], atol=1e-4)  # wv
+    np.testing.assert_allclose(w1[2], w8[2], atol=1e-3)  # alphas/distances
+    if method == "foolsgold":
+        # cross-round memory accumulated identically (id-keyed scatter)
+        np.testing.assert_allclose(np.asarray(e1.fg_state.memory),
+                                   np.asarray(e8.fg_state.memory),
+                                   atol=1e-5)
+        r1b = e1.run_round(2)
+        r8b = e8.run_round(2)
+        assert abs(r1b["global_acc"] - r8b["global_acc"]) < 1.0

@@ -1,0 +1,80 @@
+"""Cross-framework A/B parity (VERDICT r3 ask 1): the same FL rounds through
+a fresh torch implementation of the reference's client-loop semantics and
+through dba_mod_tpu, from identical initial weights and identical batch
+plans. Two kinds of claim:
+
+1. SEMANTIC parity — from bit-identical state, one full round (benign lanes,
+   poison lane with MultiStepLR + stamping + model-replacement scaling,
+   FedAvg) agrees to float-roundoff (measured ≤9e-8 abs on O(0.4) updates).
+2. STATISTICAL parity — over multiple rounds each framework integrates its
+   own f32 rounding (reordered reductions cross ReLU boundaries and the
+   trajectories separate chaotically), but main/backdoor accuracy stays
+   within the ±1% north star (BASELINE.json; measured 0.0).
+
+Measured gaps are committed in PARITY_AB.md (python -m benchmarks.parity_ab).
+"""
+import numpy as np
+
+from benchmarks.parity_ab import CIFAR_AB, MNIST_AB, MNIST_AB_R1, run_ab
+
+
+def _check_accuracy(rep):
+    for r in rep["rounds"]:
+        assert r["clean_acc_gap"] <= 1.0, r
+        assert r["backdoor_acc_gap"] <= 1.0, r
+        assert np.isfinite(r["jax_clean_acc"])
+
+
+def test_mnist_identical_state_round_is_bit_tight():
+    """Round 1 from identical weights: 2 poison clients (20 masked SGD steps,
+    milestones firing at internal epochs 1 and 4, ×3 scaling) + 2 benign
+    clients. Everything agrees to float roundoff — the composed client loop
+    is semantically identical, not just per-op."""
+    rep = run_ab(dict(MNIST_AB_R1), 1)
+    r = rep["rounds"][0]
+    for pc in r["per_client"]:
+        assert pc["max_abs_diff"] <= 1e-6, pc
+    assert r["global_max_abs_diff"] <= 1e-6, r
+    _check_accuracy(rep)
+
+
+def test_mnist_ab_parity_four_rounds():
+    """4 rounds covering benign-only, mixed, and both-adversaries rounds
+    (poison epochs 2-4). Deltas stay inside a 2% drift envelope (pure f32
+    accumulation chaos — see the identical-state test for the semantic
+    claim); accuracies inside the ±1% north star."""
+    rep = run_ab(dict(MNIST_AB), 4)
+    for r in rep["rounds"]:
+        for pc in r["per_client"]:
+            # inherited drift compounds against the GLOBAL weight scale
+            # round over round (measured ≤1.5e-2 by round 4, PARITY_AB.md);
+            # this bound is a gross-divergence tripwire — the semantic
+            # claim lives in the identical-state test, the statistical one
+            # in the accuracy bar
+            assert pc["max_abs_diff"] <= 0.08, (r["epoch"], pc)
+        assert r["global_max_abs_diff"] <= 0.05, r
+    _check_accuracy(rep)
+
+
+def test_cifar_bn_ab_parity():
+    """CIFAR ResNet-18 with BatchNorm: one poisoned + one mixed round;
+    batch_stats (running mean + UNBIASED running var, models/norm.py) travel
+    through delta/scaling/FedAvg exactly like torch.
+
+    Unlike MNIST, deep conv nets cannot be bit-tight ACROSS frameworks:
+    XLA and torch conv kernels differ at ~1e-6 (summation order), and any
+    activation within that band of zero flips its ReLU gate, changing one
+    unit's backward contribution outright. Measured: single fwd pass agrees
+    to 2e-6, loss to 2e-7, BN stats to 6e-8, but per-step worst-leaf grads
+    drift up to ~1e-2 relative with the drifting LAYER moving randomly
+    across seeds — the signature of chaotic gate flips, not of a systematic
+    semantic error (a real bug would pin to a fixed layer; disabling
+    torch's oneDNN changes nothing). Hence: drift envelope on deltas, exact
+    bar on accuracies."""
+    rep = run_ab(dict(CIFAR_AB), 2)
+    for r in rep["rounds"]:
+        for pc in r["per_client"]:
+            # measured ≤2.3e-2 (PARITY_AB.md); gross-divergence tripwire
+            assert pc["max_abs_diff"] <= 0.1, (r["epoch"], pc)
+        assert r["global_max_abs_diff"] <= 0.05, r
+    _check_accuracy(rep)
