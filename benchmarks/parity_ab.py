@@ -25,6 +25,13 @@ Known cross-framework deviations (documented in README quirk table):
   any computation here (BN momentum is fixed, not averaged), so those keys are
   excluded from state comparison and from FedAvg accumulation.
 
+Scope: MNIST (all three aggregators — FedAvg, RFA geometric median,
+FoolsGold with memory) and CIFAR-BN (FedAvg). LOAN is excluded by
+necessity: LoanNet trains with Dropout(0.5), and dropout mask RNG streams
+are framework-specific, so no cross-framework run can share a trajectory —
+LOAN's client loop is covered by per-op torch goldens (tests/test_sgd.py),
+the adaptive-LR rule test, and the end-to-end attack test instead.
+
 What tightness to expect (measured, see tests/test_parity_ab.py):
 - MNIST (conv+maxpool+fc, no BN): BIT-TIGHT from identical state — ≤9e-8
   abs on O(0.4) updates through 20-step poison rounds with scaling.
@@ -228,6 +235,7 @@ class TorchFL:
         self.test_y = torch.tensor(test_labels.astype(np.int64))
         self.bank = torch.tensor(pattern_bank)  # [K, H, W]; row K-1 combined
         self.swap = int(raw["poison_label_swap"])
+        self.fg_memory_dict: Dict = {}  # FoolsGold cross-round memory
 
     # -- reference adversarial-index resolution (image_train.py:37-48) --
     def _adv_of(self, name, epoch):
@@ -247,7 +255,9 @@ class TorchFL:
         import torch
         import torch.nn.functional as F
         raw = self.raw
+        is_fg = raw.get("aggregation_methods", "mean") == "foolsgold"
         deltas = []
+        fg_client_grads = []  # per client: {param_name: summed raw grads}
         for c, name in enumerate(agent_names):
             model = self.model
             model.load_state_dict(self.global_sd, strict=False)
@@ -275,6 +285,8 @@ class TorchFL:
                                       weight_decay=float(raw["decay"]))
                 sched, ppb, bank_row = None, 0, None
             alpha = float(raw.get("alpha_loss", 1.0))
+            cg = {k: np.zeros_like(p.detach().numpy())
+                  for k, p in model.named_parameters()} if is_fg else None
             model.train()
             for e in range(n_e):
                 for s in range(idx.shape[2]):
@@ -295,6 +307,11 @@ class TorchFL:
                         loss = alpha * loss + (1 - alpha) * _dist_norm(
                             model, anchor_params)
                     loss.backward()
+                    if is_fg:
+                        # raw per-batch grads accumulated over the round
+                        # (image_train.py:94-100, :212-218)
+                        for k, p in model.named_parameters():
+                            cg[k] += p.grad.numpy()
                     opt.step()
                 if sched is not None and bool(raw.get("poison_step_lr")):
                     sched.step()  # END of internal epoch (image_train:118)
@@ -311,17 +328,122 @@ class TorchFL:
                     continue
                 delta[k] = (v - self.global_sd[k]).numpy().copy()
             deltas.append(delta)
-        # FedAvg (helper.py:240-257): global += eta/no_models · Σ deltas
-        scale = float(raw["eta"]) / int(raw["no_models"])
+            if is_fg:
+                fg_client_grads.append(cg)
+        if is_fg:
+            self._foolsgold_update(fg_client_grads, agent_names)
+        elif raw.get("aggregation_methods", "mean") == "geom_median":
+            # RFA: alphas are the per-client dataset sizes the clients
+            # reported (= partition sizes; see README quirk table row)
+            num_samples = [int(mask[c, 0].sum())
+                           for c in range(len(agent_names))]
+            self._rfa_update(deltas, num_samples)
+        else:
+            # FedAvg (helper.py:240-257): global += eta/no_models · Σ deltas
+            scale = float(raw["eta"]) / int(raw["no_models"])
+            for k in self.global_sd:
+                if "num_batches_tracked" in k:
+                    continue
+                acc = np.zeros_like(deltas[0][k])
+                for d in deltas:
+                    acc += d[k]
+                self.global_sd[k] = self.global_sd[k] + torch.tensor(
+                    (scale * acc).astype(acc.dtype))
+        return deltas
+
+    def _rfa_update(self, deltas, num_samples):
+        """RFA geometric median, reference semantics (helper.py:295-373):
+        Weiszfeld iterations with sample-count alphas, eps-floored distances,
+        ftol early break; global += eta · median (NOT divided by clients)."""
+        import torch
+        eps, ftol = 1e-5, 1e-6
+        maxiter = int(self.raw.get("geom_median_maxiter", 10))
+        alphas = np.asarray(num_samples, np.float64)
+        alphas = (alphas / alphas.sum()).astype(np.float32)
+
+        def dist(a, b):
+            return float(np.sqrt(sum(
+                np.sum((a[k] - b[k]).astype(np.float64) ** 2) for k in a)))
+
+        def wavg(ws):
+            tot = float(np.sum(ws))
+            return {k: sum((w / tot) * d[k] for w, d in zip(ws, deltas))
+                    for k in deltas[0]}
+
+        def objective(m):
+            return sum(a * dist(m, p) for a, p in zip(alphas, deltas))
+
+        median = wavg(alphas)
+        obj = objective(median)
+        for _ in range(maxiter):
+            prev_obj = obj
+            weights = np.asarray(
+                [a / max(eps, dist(median, p))
+                 for a, p in zip(alphas, deltas)], np.float32)
+            median = wavg(weights)
+            obj = objective(median)
+            if abs(prev_obj - obj) < ftol * obj:
+                break
+        eta = float(self.raw["eta"])
         for k in self.global_sd:
             if "num_batches_tracked" in k:
                 continue
-            acc = np.zeros_like(deltas[0][k])
-            for d in deltas:
-                acc += d[k]
             self.global_sd[k] = self.global_sd[k] + torch.tensor(
-                (scale * acc).astype(acc.dtype))
-        return deltas
+                (eta * median[k]).astype(median[k].dtype))
+
+    def _foolsgold_update(self, client_grads, agent_names):
+        """FoolsGold, reference semantics (helper.py:259-293, :527-607):
+        cosine similarity over the second-to-last named parameter's
+        round-accumulated gradient, id-keyed cross-round memory, pardoning,
+        the logit re-weighting incl. the `isinf + wv > 1` precedence quirk,
+        then ONE fresh torch-SGD step on the global trainable params with
+        the wv-weighted, eta-scaled mean gradient."""
+        import torch
+        raw = self.raw
+        names = list(client_grads[0].keys())
+        sim_key = names[-2]  # [-2] named parameter (helper.py:537)
+        n = len(client_grads)
+        grads = np.stack([cg[sim_key].reshape(-1) for cg in client_grads])
+        memory = np.zeros_like(grads)
+        for i, a in enumerate(agent_names):
+            if a in self.fg_memory_dict:
+                self.fg_memory_dict[a] = self.fg_memory_dict[a] + grads[i]
+            else:
+                self.fg_memory_dict[a] = grads[i].copy()
+            memory[i] = self.fg_memory_dict[a]
+        basis = memory if bool(raw.get("fg_use_memory")) else grads
+        norms = np.linalg.norm(basis, axis=1, keepdims=True)
+        cs = (basis / np.maximum(norms, 1e-30)) @ (
+            basis / np.maximum(norms, 1e-30)).T - np.eye(n)
+        maxcs = np.max(cs, axis=1)
+        for i in range(n):          # pardoning (helper.py:585-591)
+            for j in range(n):
+                if i != j and maxcs[i] < maxcs[j]:
+                    cs[i][j] = cs[i][j] * maxcs[i] / maxcs[j]
+        wv = 1 - np.max(cs, axis=1)
+        wv[wv > 1] = 1
+        wv[wv < 0] = 0
+        wv = wv / np.max(wv)
+        wv[wv == 1] = .99
+        with np.errstate(divide="ignore"):
+            wv = np.log(wv / (1 - wv)) + 0.5
+        wv[(np.isinf(wv) + wv > 1)] = 1  # reference precedence quirk
+        wv[wv < 0] = 0
+        # aggregated gradient, eta-scaled, through one fresh SGD step
+        model = self.model
+        model.load_state_dict(self.global_sd, strict=False)
+        opt = torch.optim.SGD(model.parameters(), lr=float(raw["lr"]),
+                              momentum=float(raw["momentum"]),
+                              weight_decay=float(raw["decay"]))
+        opt.zero_grad()
+        for k, p in model.named_parameters():
+            agg = sum(wv[c] * client_grads[c][k] for c in range(n)) / n
+            p.grad = torch.tensor(
+                (float(raw["eta"]) * agg).astype(np.float32))
+        opt.step()
+        for k, v in model.state_dict().items():
+            if "num_batches_tracked" not in k:
+                self.global_sd[k] = v.clone()
 
     # -- evaluation (test.py:7-115) --
     def _eval(self, poisoned: bool, batch: int = 512):
@@ -466,6 +588,18 @@ MNIST_AB_R1 = dict(MNIST_AB,
                    **{"0_poison_epochs": [1, 2, 3, 4],
                       "1_poison_epochs": [1, 3, 4]})
 
+# RFA variant of the identical-state round: the full Weiszfeld pipeline
+# (sample-count alphas, eps-floored distance weights, ftol break, eta·median
+# global step) composed with real poisoned client deltas, cross-framework.
+MNIST_AB_RFA = dict(MNIST_AB_R1, aggregation_methods="geom_median",
+                    geom_median_maxiter=10)
+
+# FoolsGold variant: similarity over the [-2] parameter's round-accumulated
+# gradient, id-keyed memory chaining across rounds, pardoning + logit quirks,
+# server SGD step — composed with real sybil (two-adversary) deltas.
+MNIST_AB_FG = dict(MNIST_AB_R1, aggregation_methods="foolsgold",
+                   fg_use_memory=True)
+
 # client partitions (256/4 = 64 samples) divide batch_size exactly: BN batch
 # statistics see no wrap-padding on either side (README quirk table row on
 # partial-batch BN padding)
@@ -537,6 +671,13 @@ def main():
         "clients):\n\n")
     rep = run_ab(dict(MNIST_AB_R1), 1)
     out.write(_fmt_report(dict(rep, type="mnist (identical-state)")))
+    rep = run_ab(dict(MNIST_AB_RFA), 1)
+    out.write(_fmt_report(dict(rep, type="mnist + RFA geometric median "
+                                          "(identical-state)")))
+    rep = run_ab(dict(MNIST_AB_FG), 2)
+    out.write(_fmt_report(dict(
+        rep, type="mnist + FoolsGold w/ memory (round 1 identical-state, "
+                  "round 2 chains the memory)")))
     out.write(
         "\n## Multi-round runs (statistical parity)\n\n"
         "Each framework integrates its own f32 rounding across rounds "

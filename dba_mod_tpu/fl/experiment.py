@@ -326,10 +326,13 @@ class Experiment:
                         logger.warning(
                             "warm_step_buckets: compile for S=%d failed "
                             "twice (%r); will compile on first use", s, exc)
-        if buckets and len(failures) == len(buckets):
-            # every bucket failing is not a transient RPC hiccup — it means
-            # the warm shapes (or the round program itself) are broken, and
-            # hiding that would resurface as a crash mid-run, far from here
+        if len(buckets) > 1 and len(failures) == len(buckets):
+            # SEVERAL independent shapes all failing is not a transient RPC
+            # hiccup — the warm shapes (or the round program itself) are
+            # broken, and hiding that would resurface as a crash mid-run,
+            # far from here. (A single-bucket failure stays a warning: two
+            # transient remote-compile 500s in a row must not abort a run
+            # that compile-on-first-use would recover.)
             raise RuntimeError(
                 "warm_step_buckets: every step bucket failed to compile; "
                 f"first error: {failures[0][1]!r}") from failures[0][1]

@@ -50,8 +50,14 @@ class BatchNorm(nn.Module):
             xf = x.astype(jnp.float32).reshape(-1, features)
             n = xf.shape[0]
             mean = jnp.mean(xf, axis=0)
-            # biased variance normalizes the batch (torch train-mode output)
-            var = jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean)
+            # biased variance normalizes the batch (torch train-mode
+            # output); clamp at 0 — E[x²]−E[x]² can go slightly negative
+            # under f32 cancellation for large-mean channels, and a negative
+            # value would NaN the rsqrt and poison running_var (torch's
+            # centered computation is never negative; flax clamps the same
+            # way)
+            var = jnp.maximum(
+                0.0, jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean))
             if not self.is_initializing():
                 # torch running update uses the UNBIASED variance
                 bessel = n / max(n - 1, 1)

@@ -78,3 +78,32 @@ def test_cifar_bn_ab_parity():
             assert pc["max_abs_diff"] <= 0.1, (r["epoch"], pc)
         assert r["global_max_abs_diff"] <= 0.05, r
     _check_accuracy(rep)
+
+
+def test_mnist_rfa_identical_state_round():
+    """RFA geometric median cross-framework: the torch side implements the
+    reference Weiszfeld flow (helper.py:295-373) independently; from
+    identical state the aggregated global models must agree to float
+    roundoff (distances computed in different precisions leave ~1e-6)."""
+    from benchmarks.parity_ab import MNIST_AB_RFA
+    rep = run_ab(dict(MNIST_AB_RFA), 1)
+    r = rep["rounds"][0]
+    for pc in r["per_client"]:
+        assert pc["max_abs_diff"] <= 1e-6, pc  # train is agg-independent
+    assert r["global_max_abs_diff"] <= 2e-5, r
+    _check_accuracy(rep)
+
+
+def test_mnist_foolsgold_identical_state_rounds():
+    """FoolsGold cross-framework: cosine-similarity reweighting over the
+    [-2] parameter's accumulated gradient (sybil adversaries 0/1 share a
+    trigger objective), id-keyed memory, pardoning + logit quirks, and the
+    server SGD step — torch side independent (helper.py:259-293, :527-607).
+    Round 1 from identical state is tight; round 2 chains the memory."""
+    from benchmarks.parity_ab import MNIST_AB_FG
+    rep = run_ab(dict(MNIST_AB_FG), 2)
+    r1 = rep["rounds"][0]
+    for pc in r1["per_client"]:
+        assert pc["max_abs_diff"] <= 1e-6, pc  # train is agg-independent
+    assert r1["global_max_abs_diff"] <= 1e-5, r1
+    _check_accuracy(rep)
