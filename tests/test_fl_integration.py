@@ -280,3 +280,32 @@ def test_sequential_debug_matches_vmapped():
     lv = jax.tree_util.tree_leaves(e_v.global_vars.params)[0]
     ls = jax.tree_util.tree_leaves(e_s.global_vars.params)[0]
     np.testing.assert_allclose(np.asarray(lv), np.asarray(ls), atol=2e-3)
+
+
+def test_loan_stale_poison_probe_skips_blocking_eval():
+    """stale_poison_probe (flag-gated deviation, README): poison rounds
+    reuse the previous round's recorded backdoor accuracy instead of the
+    blocking mid-round probe of the current model (loan_train.py:67-75);
+    with the flag off the blocking probe runs."""
+    def counting(e):
+        calls = []
+        orig = e.engine.backdoor_acc_fn
+        e.engine.backdoor_acc_fn = (
+            lambda v: calls.append(1) or orig(v))
+        return calls
+
+    e = Experiment(Params.from_dict(dict(LOAN, stale_poison_probe=True)),
+                   save_results=False)
+    calls = counting(e)
+    out = {}
+    for i in range(1, 4):
+        out[i] = e.run_round(i)  # round 1 records the backdoor accuracy
+        assert np.isfinite(out[i]["global_acc"])
+    # poison rounds 2 and 3 had history → the blocking probe never ran
+    assert calls == []
+
+    e2 = Experiment(Params.from_dict(LOAN), save_results=False)
+    calls2 = counting(e2)
+    e2.run_round(1)
+    e2.run_round(2)  # AK poisons epoch 2 → blocking probe
+    assert len(calls2) == 1

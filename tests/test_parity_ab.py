@@ -106,4 +106,8 @@ def test_mnist_foolsgold_identical_state_rounds():
     for pc in r1["per_client"]:
         assert pc["max_abs_diff"] <= 1e-6, pc  # train is agg-independent
     assert r1["global_max_abs_diff"] <= 1e-5, r1
+    # round 2 exercises the id-keyed memory chaining: still tight (measured
+    # 2.8e-6) — a memory-path regression would blow this long before the
+    # coarse accuracy bar noticed
+    assert rep["rounds"][1]["global_max_abs_diff"] <= 1e-4, rep["rounds"][1]
     _check_accuracy(rep)

@@ -91,3 +91,27 @@ def test_resume_past_final_epoch_runs_nothing(tmp_path, capsys):
     resume_yaml.write_text(yaml.safe_dump(cfg_resume))
     assert main(["train", "--params", str(resume_yaml), "--no-save"]) == 0
     assert "no rounds to run" in capsys.readouterr().out
+
+
+def test_best_val_checkpoint_tracks_lowest_global_loss(tmp_path):
+    """helper.py:433-435 via main.py:233: `model_last.pt.tar.best` is
+    (re)written whenever the round's global eval loss improves on the best
+    seen, alongside the unconditional model_last."""
+    cfg = dict(CLEAN, save_model=True, epochs=3)
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    e.folder = tmp_path  # unit-level: inject the run folder
+    losses = {}
+    for i in (1, 2, 3):
+        e.run_round(i)
+        e.save_model(i)
+        losses[i] = e.last_global_loss
+    best = tmp_path / "model_last.pt.tar.best"
+    assert best.exists() and (tmp_path / "model_last.pt.tar").exists()
+    like = e.model_def.init_vars(jax.random.key(0))
+    _, best_epoch, _ = ckpt.load_checkpoint(best, like)
+    assert best_epoch == min(losses, key=losses.get)
+    # a non-improving round must NOT overwrite the best snapshot
+    e.last_global_loss = e.best_loss + 1.0
+    e.save_model(9)
+    _, still_epoch, _ = ckpt.load_checkpoint(best, like)
+    assert still_epoch == best_epoch
