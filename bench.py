@@ -57,9 +57,8 @@ def _make_experiment():
     import jax
     # persistent compile cache: the 5 step-bucket shapes + eval programs
     # compile once per machine, not once per bench run
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_dba_bench")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache("/tmp/jax_cache_dba_bench")
     from dba_mod_tpu.config import Params
     from dba_mod_tpu.fl.experiment import Experiment
     exp = Experiment(Params.from_dict(BENCH_CONFIG), save_results=False)
@@ -204,7 +203,8 @@ def baseline_seconds_per_round(skip: bool) -> float | None:
 def main() -> int:
     ap = argparse.ArgumentParser()
     # 12 timed rounds: the tunnel's ~0.07-0.16 s sync-latency jitter puts
-    # ±3% run-to-run noise on a 5-round measurement; 12 halves it
+    # ±3% run-to-run noise on a 5-round measurement; 12 cuts it ~35%
+    # (1/√n scaling)
     ap.add_argument("--rounds", type=int, default=12)
     ap.add_argument("--skip-baseline", action="store_true")
     ap.add_argument("--no-phases", action="store_true")

@@ -649,9 +649,8 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_dba_tests")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
     out = io.StringIO()
     out.write(
         "# Cross-framework A/B parity (torch reference semantics vs "

@@ -35,9 +35,8 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_dba_bench")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache("/tmp/jax_cache_dba_bench")
 
     from bench import BENCH_CONFIG
     from dba_mod_tpu.config import Params

@@ -1,0 +1,16 @@
+"""Shared persistent XLA compile-cache setup.
+
+ResNet-sized round programs take minutes to compile (longer through the TPU
+remote-compile path); every entry point that compiles them — bench, tests,
+the multichip dryrun, probes — enables the same persistent cache so a shape
+compiles once per machine. One helper so the knobs can't silently diverge
+across call sites."""
+from __future__ import annotations
+
+
+def enable_compile_cache(path: str = "/tmp/jax_cache_dba_tests") -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

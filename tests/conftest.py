@@ -21,8 +21,8 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: the suite's cost is XLA compiles of model-sized
 # programs; cache them across runs (safe to delete anytime).
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_dba_tests")
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
 assert jax.device_count() == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}")

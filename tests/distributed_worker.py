@@ -21,9 +21,8 @@ def main():
     os.environ["JAX_PROCESS_ID"] = str(process_id)
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_dba_tests")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
 
     from dba_mod_tpu.config import Params
     from dba_mod_tpu.fl.experiment import Experiment
