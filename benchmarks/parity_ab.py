@@ -25,12 +25,22 @@ Known cross-framework deviations (documented in README quirk table):
   any computation here (BN momentum is fixed, not averaged), so those keys are
   excluded from state comparison and from FedAvg accumulation.
 
-Scope: MNIST (all three aggregators — FedAvg, RFA geometric median,
-FoolsGold with memory) and CIFAR-BN (FedAvg). LOAN is excluded by
-necessity: LoanNet trains with Dropout(0.5), and dropout mask RNG streams
-are framework-specific, so no cross-framework run can share a trajectory —
-LOAN's client loop is covered by per-op torch goldens (tests/test_sgd.py),
-the adaptive-LR rule test, and the end-to-end attack test instead.
+Scope — all four workloads: MNIST (all three aggregators — FedAvg, RFA
+geometric median, FoolsGold with memory — plus an aggr_epoch_interval=2
+round with per-segment re-anchoring), CIFAR-BN (FedAvg),
+Tiny-ImageNet (FedAvg, centralized combined trigger, imagenet stem +
+global pool), and LOAN (FedAvg, feature triggers, scheduler-steps-first
+MultiStepLR, adaptive poison LR). LOAN
+trains with Dropout(0.5), and dropout mask RNG streams are
+framework-specific — so the harness makes the masks a SHARED input, like
+the batch plans: the exact masks the flax engine draws are recovered from
+its per-step RNG keys (a probe forward with zero kernels / ones biases
+turns the captured Dropout intermediates into the {0,1} masks,
+`extract_loan_dropout_masks`) and the torch twin consumes them through a
+mask-fed Dropout module. Everything else on the torch side — trigger
+feature assignment, the top-of-epoch scheduler step, the backdoor-accuracy
+LR decay — is implemented from the reference semantics
+(loan_train.py:47-127, test.py:61-115).
 
 What tightness to expect (measured, see tests/test_parity_ab.py):
 - MNIST (conv+maxpool+fc, no BN): BIT-TIGHT from identical state — ≤9e-8
@@ -82,10 +92,14 @@ def build_torch_mnist():
     return Net()
 
 
-def build_torch_cifar():
-    """Reference narrow CIFAR ResNet-18 (models/resnet_cifar.py:70-116):
-    3×3 stem, widths 32/64/128/256, BasicBlock [2,2,2,2], 4×4 avg pool."""
-    import torch
+_TORCH_BLOCK_CLS = None
+
+
+def _torch_block_cls():
+    """The BasicBlock both torch ResNet twins share (lazy torch import)."""
+    global _TORCH_BLOCK_CLS
+    if _TORCH_BLOCK_CLS is not None:
+        return _TORCH_BLOCK_CLS
     import torch.nn as nn
     import torch.nn.functional as F
 
@@ -106,6 +120,19 @@ def build_torch_cifar():
             y = self.bn2(self.conv2(y))
             s = self.sc_bn(self.sc_conv(x)) if self.has_short else x
             return F.relu(y + s)
+
+    _TORCH_BLOCK_CLS = Block
+    return Block
+
+
+def build_torch_cifar():
+    """Reference narrow CIFAR ResNet-18 (models/resnet_cifar.py:70-116):
+    3×3 stem, widths 32/64/128/256, BasicBlock [2,2,2,2], 4×4 avg pool."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    Block = _torch_block_cls()
 
     class Net(nn.Module):
         def __init__(self):
@@ -190,8 +217,163 @@ def cifar_state_to_torch(mv) -> Dict[str, np.ndarray]:
     return out
 
 
+def build_torch_tiny():
+    """Reference Tiny-ImageNet ResNet-18 (models/resnet_tinyimagenet.py:40-238):
+    torchvision-style — 7×7/stride-2 stem, 3×3/stride-2 max pool, standard
+    64/128/256/512 BasicBlock [2,2,2,2], global average pool, 200-class head.
+    Reuses the CIFAR twin's Block; module names mirror the flax tree so
+    `cifar_state_to_torch` maps both variants."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    Block = _torch_block_cls()
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem_conv = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.stem_bn = nn.BatchNorm2d(64)
+            blocks = []
+            in_p = 64
+            for stage, p in enumerate([64, 128, 256, 512]):
+                for i in range(2):
+                    stride = 2 if (stage > 0 and i == 0) else 1
+                    blocks.append(Block(in_p, p, stride))
+                    in_p = p
+            self.blocks = nn.ModuleList(blocks)
+            self.fc = nn.Linear(512, 200)
+
+        def forward(self, x):
+            x = F.relu(self.stem_bn(self.stem_conv(x)))
+            x = F.max_pool2d(x, 3, 2, 1)
+            for b in self.blocks:
+                x = b(x)
+            x = x.mean(dim=(2, 3))
+            return self.fc(x)
+
+    return Net()
+
+
+def build_torch_loan():
+    """Reference LoanNet (models/loan_model.py:10-27): 91→46→23→9, each
+    hidden layer Linear → Dropout(0.5) → ReLU, raw logits out. Dropout is a
+    mask-CONSUMING module: the client loop feeds it the exact {0,1} masks the
+    flax engine drew for the same (client, epoch, step), so both frameworks
+    train through identical dropout patterns (see module docstring)."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class MaskedDropout(nn.Module):
+        def __init__(self, rate):
+            super().__init__()
+            self.rate = rate
+            self.mask = None  # [B, features] {0,1}; set per step by the loop
+
+        def forward(self, x):
+            if not self.training:
+                return x
+            m = self.mask[: x.shape[0]]
+            return x * m / (1.0 - self.rate)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(91, 46)
+            self.drop1 = MaskedDropout(0.5)
+            self.fc2 = nn.Linear(46, 23)
+            self.drop2 = MaskedDropout(0.5)
+            self.fc3 = nn.Linear(23, 9)
+
+        def forward(self, x):
+            x = F.relu(self.drop1(self.fc1(x)))
+            x = F.relu(self.drop2(self.fc2(x)))
+            return self.fc3(x)
+
+    return Net()
+
+
+def loan_state_to_torch(mv) -> Dict[str, np.ndarray]:
+    p = mv.params
+    return {f"fc{i + 1}.{t}": (np.asarray(p[f"Dense_{i}"]["kernel"]).T
+                               if t == "weight"
+                               else np.asarray(p[f"Dense_{i}"]["bias"]))
+            for i in range(3) for t in ("weight", "bias")}
+
+
 CONVERTERS = {"mnist": (build_torch_mnist, mnist_state_to_torch),
-              "cifar": (build_torch_cifar, cifar_state_to_torch)}
+              "cifar": (build_torch_cifar, cifar_state_to_torch),
+              # the flax ResNet tree names both variants identically
+              "tiny-imagenet-200": (build_torch_tiny, cifar_state_to_torch)}
+
+
+def extract_loan_dropout_masks(module, rng_t, C: int, E: int, S: int,
+                               B: int):
+    """Recover the EXACT dropout masks the jitted client step draws.
+
+    The engine derives each step's dropout key as
+    fold_in(fold_in(fold_in(fold_in(rng_t, seg), lane), e), s)
+    (fl/rounds.py:144-146, fl/client.py:108-109), and flax's nn.Dropout is a
+    pure function of that key and the module path. Applying the REAL LoanNet
+    with crafted parameters (zero kernels, ones biases → every Dropout input
+    is all-ones) and capturing the Dropout intermediates yields
+    mask/keep_prob directly — no reimplementation of flax's internal RNG
+    folding, so this stays correct across flax versions.
+
+    Returns (masks0 [C,E,S,B,46], masks1 [C,E,S,B,23]) as {0,1} float32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    seg = jax.random.fold_in(rng_t, 0)  # single segment (interval=1)
+    lanes, es, ss = np.meshgrid(np.arange(C), np.arange(E), np.arange(S),
+                                indexing="ij")
+
+    def step_key(lane, e, s):
+        client = jax.random.fold_in(seg, lane)
+        return jax.random.fold_in(jax.random.fold_in(client, e), s)
+
+    keys = jax.vmap(step_key)(jnp.asarray(lanes.ravel()),
+                              jnp.asarray(es.ravel()),
+                              jnp.asarray(ss.ravel()))
+    m0, m1 = _loan_mask_probe(module, B)(keys)
+    return (np.asarray(m0).reshape(C, E, S, B, 46),
+            np.asarray(m1).reshape(C, E, S, B, 23))
+
+
+_PROBE_CACHE: Dict = {}
+
+
+def _loan_mask_probe(module, B: int):
+    """Jitted vmapped probe, cached per (module, batch) so per-round calls
+    reuse one compilation."""
+    key = (id(module), B)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    probe = {"Dense_0": {"kernel": jnp.zeros((91, 46)),
+                         "bias": jnp.ones((46,))},
+             "Dense_1": {"kernel": jnp.zeros((46, 23)),
+                         "bias": jnp.ones((23,))},
+             "Dense_2": {"kernel": jnp.zeros((23, 9)),
+                         "bias": jnp.ones((9,))}}
+
+    def _probe(k):
+        _, st = module.apply(
+            {"params": probe}, jnp.ones((B, 91)), train=True,
+            rngs={"dropout": k}, mutable=["intermediates"],
+            capture_intermediates=lambda m, _: isinstance(m, nn.Dropout))
+        inter = st["intermediates"]
+        return (inter["Dropout_0"]["__call__"][0] * 0.5,
+                inter["Dropout_1"]["__call__"][0] * 0.5)
+
+    fn = jax.jit(jax.vmap(_probe))
+    _PROBE_CACHE[key] = fn
+    return fn
 
 
 # ------------------------------------------------- torch reference semantics
@@ -201,12 +383,44 @@ def _torch_stamp(x, bank_mask):
     return x * (1.0 - bank_mask) + bank_mask
 
 
-def _dist_norm(model, anchor):
+def _adv_of(raw: dict, name, epoch):
+    """Reference adversarial-index resolution + poison-epoch gate
+    (image_train.py:37-48, :56; loan_train.py:35-45, :65): the slot index,
+    -1 (combined trigger) when there is a single adversary, None when this
+    client is not poisoning this epoch."""
+    advs = list(raw.get("adversary_list", []))
+    if not raw.get("is_poison") or name not in advs:
+        return None
+    slot = advs.index(name)
+    if epoch not in list(raw.get(f"{slot}_poison_epochs", [])):
+        return None
+    return -1 if len(advs) == 1 else slot
+
+
+def _fedavg_apply(raw: dict, global_sd, deltas):
+    """FedAvg (helper.py:240-257): global += eta/no_models · Σ deltas."""
     import torch
-    sq = 0.0
-    for name, prm in model.named_parameters():
-        sq = sq + torch.sum((prm - anchor[name]) ** 2)
-    return torch.sqrt(sq)
+    scale = float(raw["eta"]) / int(raw["no_models"])
+    for k in global_sd:
+        if "num_batches_tracked" in k:
+            continue
+        acc = np.zeros_like(deltas[0][k])
+        for d in deltas:
+            acc += d[k]
+        global_sd[k] = global_sd[k] + torch.tensor(
+            (scale * acc).astype(acc.dtype))
+
+
+def _dist_norm(model, anchor):
+    """helper.py:110-123 flattens (w - w_target) into one vector and takes
+    torch.norm — whose subgradient at the zero vector is 0. A client's FIRST
+    poison batch has w == w_anchor exactly, so composing sqrt(Σ(w-a)²) by
+    hand would inject NaN (0·∞) there; torch.norm (like the engine's
+    double-where tree_dist_norm) does not."""
+    import torch
+    v = torch.cat([(prm - anchor[name]).reshape(-1)
+                   for name, prm in model.named_parameters()])
+    return torch.norm(v, 2)
 
 
 class TorchFL:
@@ -237,91 +451,100 @@ class TorchFL:
         self.swap = int(raw["poison_label_swap"])
         self.fg_memory_dict: Dict = {}  # FoolsGold cross-round memory
 
-    # -- reference adversarial-index resolution (image_train.py:37-48) --
     def _adv_of(self, name, epoch):
-        raw = self.raw
-        advs = list(raw.get("adversary_list", []))
-        if not raw.get("is_poison") or name not in advs:
-            return None
-        slot = advs.index(name)
-        if epoch not in list(raw.get(f"{slot}_poison_epochs", [])):
-            return None
-        return -1 if len(advs) == 1 else slot
+        return _adv_of(self.raw, name, epoch)
 
-    def run_round(self, epoch: int, agent_names: List, idx: np.ndarray,
-                  mask: np.ndarray) -> List[Dict[str, np.ndarray]]:
-        """One reference round over recorded plans idx/mask [C, E, S, B].
-        Returns per-client delta state_dicts; applies FedAvg to the global."""
+    def run_round(self, seg_epochs: List[int], agent_names: List,
+                  idx_seq: np.ndarray, mask_seq: np.ndarray
+                  ) -> List[Dict[str, np.ndarray]]:
+        """One reference round over recorded plans idx/mask [I, C, E, S, B] —
+        one segment per global epoch in the aggregation interval
+        (image_train.py:50-171): the benign optimizer persists across
+        segments (built once per client, :33), the poison optimizer and its
+        scheduler are fresh per poison segment (:59-68), and the
+        distance/scaling anchor re-snapshots to the client's state at each
+        segment start (:52-54, :168, :306). Returns per-client WHOLE-ROUND
+        delta state_dicts (= the sum of the reference's per-epoch submit
+        list, helper.py:193-231); applies the aggregation rule."""
         import torch
         import torch.nn.functional as F
         raw = self.raw
         is_fg = raw.get("aggregation_methods", "mean") == "foolsgold"
+        alpha = float(raw.get("alpha_loss", 1.0))
         deltas = []
         fg_client_grads = []  # per client: {param_name: summed raw grads}
         for c, name in enumerate(agent_names):
             model = self.model
             model.load_state_dict(self.global_sd, strict=False)
+            benign_opt = torch.optim.SGD(model.parameters(),
+                                         lr=float(raw["lr"]),
+                                         momentum=float(raw["momentum"]),
+                                         weight_decay=float(raw["decay"]))
             anchor = {k: v.clone() for k, v in self.global_sd.items()}
-            anchor_params = {k: v for k, v in anchor.items()
-                             if "running_" not in k
-                             and "num_batches_tracked" not in k}
-            adv = self._adv_of(name, epoch)
-            if adv is not None:
-                n_e = int(raw["internal_poison_epochs"])
-                opt = torch.optim.SGD(model.parameters(),
-                                      lr=float(raw["poison_lr"]),
-                                      momentum=float(raw["momentum"]),
-                                      weight_decay=float(raw["decay"]))
-                sched = torch.optim.lr_scheduler.MultiStepLR(
-                    opt, milestones=[0.2 * n_e, 0.8 * n_e], gamma=0.1)
-                ppb = int(raw["poisoning_per_batch"])
-                bank_row = self.bank[adv if adv >= 0 else self.bank.shape[0]
-                                     - 1]
-            else:
-                n_e = int(raw["internal_epochs"])
-                opt = torch.optim.SGD(model.parameters(),
-                                      lr=float(raw["lr"]),
-                                      momentum=float(raw["momentum"]),
-                                      weight_decay=float(raw["decay"]))
-                sched, ppb, bank_row = None, 0, None
-            alpha = float(raw.get("alpha_loss", 1.0))
             cg = {k: np.zeros_like(p.detach().numpy())
                   for k, p in model.named_parameters()} if is_fg else None
             model.train()
-            for e in range(n_e):
-                for s in range(idx.shape[2]):
-                    sel = mask[c, e, s]
-                    n_valid = int(sel.sum())
-                    if n_valid == 0:
-                        continue
-                    ids = idx[c, e, s, :n_valid]
-                    x = self.train_x[ids].clone()
-                    y = self.train_y[ids].clone()
-                    if ppb > 0:
-                        k = min(ppb, n_valid)
-                        x[:k] = _torch_stamp(x[:k], bank_row)
-                        y[:k] = self.swap
-                    opt.zero_grad()
-                    loss = F.cross_entropy(model(x), y)
-                    if alpha != 1.0:
-                        loss = alpha * loss + (1 - alpha) * _dist_norm(
-                            model, anchor_params)
-                    loss.backward()
-                    if is_fg:
-                        # raw per-batch grads accumulated over the round
-                        # (image_train.py:94-100, :212-218)
-                        for k, p in model.named_parameters():
-                            cg[k] += p.grad.numpy()
-                    opt.step()
-                if sched is not None and bool(raw.get("poison_step_lr")):
-                    sched.step()  # END of internal epoch (image_train:118)
-            if adv is not None and not bool(raw.get("baseline")):
-                gamma = float(raw["scale_weights_poison"])
-                sd = model.state_dict()
-                for k in sd:  # full state incl BN (image_train.py:166-171)
-                    if "num_batches_tracked" in k:
-                        continue
-                    sd[k].copy_(anchor[k] + (sd[k] - anchor[k]) * gamma)
+            for si, epoch in enumerate(seg_epochs):
+                idx, mask = idx_seq[si], mask_seq[si]
+                anchor_params = {k: v for k, v in anchor.items()
+                                 if "running_" not in k
+                                 and "num_batches_tracked" not in k}
+                adv = self._adv_of(name, epoch)
+                if adv is not None:
+                    n_e = int(raw["internal_poison_epochs"])
+                    opt = torch.optim.SGD(model.parameters(),
+                                          lr=float(raw["poison_lr"]),
+                                          momentum=float(raw["momentum"]),
+                                          weight_decay=float(raw["decay"]))
+                    sched = torch.optim.lr_scheduler.MultiStepLR(
+                        opt, milestones=[0.2 * n_e, 0.8 * n_e], gamma=0.1)
+                    ppb = int(raw["poisoning_per_batch"])
+                    bank_row = self.bank[adv if adv >= 0
+                                         else self.bank.shape[0] - 1]
+                else:
+                    n_e = int(raw["internal_epochs"])
+                    opt, sched, ppb, bank_row = benign_opt, None, 0, None
+                for e in range(n_e):
+                    for s in range(idx.shape[2]):
+                        sel = mask[c, e, s]
+                        n_valid = int(sel.sum())
+                        if n_valid == 0:
+                            continue
+                        ids = idx[c, e, s, :n_valid]
+                        x = self.train_x[ids].clone()
+                        y = self.train_y[ids].clone()
+                        if ppb > 0:
+                            k = min(ppb, n_valid)
+                            x[:k] = _torch_stamp(x[:k], bank_row)
+                            y[:k] = self.swap
+                        opt.zero_grad()
+                        loss = F.cross_entropy(model(x), y)
+                        if alpha != 1.0 and adv is not None:
+                            # the blend is the POISON branch's loss
+                            # (image_train.py:85-90); benign clients train
+                            # on plain CE (:203-207)
+                            loss = alpha * loss + (1 - alpha) * _dist_norm(
+                                model, anchor_params)
+                        loss.backward()
+                        if is_fg:
+                            # raw per-batch grads accumulated over the ROUND
+                            # (client_grad lives outside the epoch loop,
+                            # image_train.py:24, :94-100, :212-218)
+                            for k, p in model.named_parameters():
+                                cg[k] += p.grad.numpy()
+                        opt.step()
+                    if sched is not None and bool(raw.get("poison_step_lr")):
+                        sched.step()  # END of internal epoch (image_train:118)
+                if adv is not None and not bool(raw.get("baseline")):
+                    gamma = float(raw["scale_weights_poison"])
+                    sd = model.state_dict()
+                    for k in sd:  # full state incl BN (image_train:166-171)
+                        if "num_batches_tracked" in k:
+                            continue
+                        sd[k].copy_(anchor[k] + (sd[k] - anchor[k]) * gamma)
+                # next segment's anchor = this segment's submitted state
+                anchor = {k: v.clone()
+                          for k, v in model.state_dict().items()}
             delta = {}
             for k, v in model.state_dict().items():
                 if "num_batches_tracked" in k:
@@ -335,20 +558,11 @@ class TorchFL:
         elif raw.get("aggregation_methods", "mean") == "geom_median":
             # RFA: alphas are the per-client dataset sizes the clients
             # reported (= partition sizes; see README quirk table row)
-            num_samples = [int(mask[c, 0].sum())
+            num_samples = [int(mask_seq[0, c, 0].sum())
                            for c in range(len(agent_names))]
             self._rfa_update(deltas, num_samples)
         else:
-            # FedAvg (helper.py:240-257): global += eta/no_models · Σ deltas
-            scale = float(raw["eta"]) / int(raw["no_models"])
-            for k in self.global_sd:
-                if "num_batches_tracked" in k:
-                    continue
-                acc = np.zeros_like(deltas[0][k])
-                for d in deltas:
-                    acc += d[k]
-                self.global_sd[k] = self.global_sd[k] + torch.tensor(
-                    (scale * acc).astype(acc.dtype))
+            _fedavg_apply(raw, self.global_sd, deltas)
         return deltas
 
     def _rfa_update(self, deltas, num_samples):
@@ -475,7 +689,170 @@ class TorchFL:
         return self._eval(True)
 
 
+class TorchLoanFL:
+    """The torch side of the LOAN A/B: reference-semantics sequential FL
+    rounds (loan_train.py:11-261) over per-state shards, replaying recorded
+    batch plans and consuming the flax engine's dropout masks."""
+
+    def __init__(self, raw: dict, init_sd: Dict[str, np.ndarray],
+                 train_x: List[np.ndarray], train_y: List[np.ndarray],
+                 test_x: List[np.ndarray], test_y: List[np.ndarray],
+                 value_bank: np.ndarray, mask_bank: np.ndarray):
+        import torch
+        torch.set_num_threads(1)
+        self.raw = raw
+        self.global_sd = {k: torch.tensor(v.copy()) for k, v in
+                          init_sd.items()}
+        self.model = build_torch_loan()
+        self.model.load_state_dict(self.global_sd)
+        self.train_x = [torch.tensor(x) for x in train_x]
+        self.train_y = [torch.tensor(y.astype(np.int64)) for y in train_y]
+        self.test_x = [torch.tensor(x) for x in test_x]
+        self.test_y = [torch.tensor(y.astype(np.int64)) for y in test_y]
+        self.values = torch.tensor(value_bank)  # [K, F]; row K-1 combined
+        self.masks = torch.tensor(mask_bank)
+        self.swap = int(raw["poison_label_swap"])
+
+    def _adv_of(self, name, epoch):
+        return _adv_of(self.raw, name, epoch)
+
+    def _stamp(self, x, row):
+        m = self.masks[row]
+        return x * (1.0 - m) + self.values[row] * m
+
+    def run_round(self, epoch: int, agent_names: List, slots: np.ndarray,
+                  idx: np.ndarray, mask: np.ndarray,
+                  drop0: np.ndarray, drop1: np.ndarray):
+        """One reference round. idx/mask are the shared [C, E, S, B] plans
+        (indices into each client's state shard); drop0/drop1 the shared
+        dropout masks [C, E, S, B, ·]. Returns (per-client delta dicts,
+        poison_lr used) and applies FedAvg to the global."""
+        import torch
+        import torch.nn.functional as F
+        raw = self.raw
+        # every poison client's adaptive-LR probe evaluates its freshly
+        # synced model = the round-start global (loan_train.py:27-28, :67-75),
+        # so one probe serves the round
+        acc_p = None
+        if any(self._adv_of(n, epoch) is not None for n in agent_names):
+            acc_p = self.backdoor_acc()
+        poison_lr = float(raw["poison_lr"])
+        if acc_p is not None and not bool(raw.get("baseline")):
+            if acc_p > 20:
+                poison_lr /= 5
+            if acc_p > 60:
+                poison_lr /= 10
+        deltas = []
+        for c, name in enumerate(agent_names):
+            model = self.model
+            model.load_state_dict(self.global_sd)
+            sx, sy = self.train_x[int(slots[c])], self.train_y[int(slots[c])]
+            adv = self._adv_of(name, epoch)
+            if adv is not None:
+                n_e = int(raw["internal_poison_epochs"])
+                opt = torch.optim.SGD(model.parameters(), lr=poison_lr,
+                                      momentum=float(raw["momentum"]),
+                                      weight_decay=float(raw["decay"]))
+                sched = torch.optim.lr_scheduler.MultiStepLR(
+                    opt, milestones=[0.2 * n_e, 0.8 * n_e], gamma=0.1)
+                ppb = int(raw["poisoning_per_batch"])
+                row = adv if adv >= 0 else self.values.shape[0] - 1
+            else:
+                n_e = int(raw["internal_epochs"])
+                opt = torch.optim.SGD(model.parameters(),
+                                      lr=float(raw["lr"]),
+                                      momentum=float(raw["momentum"]),
+                                      weight_decay=float(raw["decay"]))
+                sched, ppb, row = None, 0, None
+            model.train()
+            for e in range(n_e):
+                if sched is not None and bool(raw.get("poison_step_lr")):
+                    sched.step()  # TOP of the internal epoch
+                    # (loan_train.py:90-92 steps before the batches)
+                for s in range(idx.shape[2]):
+                    sel = mask[c, e, s]
+                    n_valid = int(sel.sum())
+                    if n_valid == 0:
+                        continue
+                    ids = idx[c, e, s, :n_valid]
+                    x = sx[ids].clone()
+                    y = sy[ids].clone()
+                    if ppb > 0:
+                        k = min(ppb, n_valid)
+                        x[:k] = self._stamp(x[:k], row)
+                        y[:k] = self.swap
+                    model.drop1.mask = torch.tensor(drop0[c, e, s])
+                    model.drop2.mask = torch.tensor(drop1[c, e, s])
+                    opt.zero_grad()
+                    loss = F.cross_entropy(model(x), y)
+                    loss.backward()
+                    opt.step()
+            if adv is not None and not bool(raw.get("baseline")):
+                gamma = float(raw["scale_weights_poison"])
+                sd = model.state_dict()
+                for k in sd:
+                    sd[k].copy_(self.global_sd[k] +
+                                (sd[k] - self.global_sd[k]) * gamma)
+            deltas.append({k: (v - self.global_sd[k]).numpy().copy()
+                           for k, v in model.state_dict().items()})
+        _fedavg_apply(raw, self.global_sd, deltas)
+        return deltas, (poison_lr if acc_p is not None else None)
+
+    def _eval(self, poisoned: bool, batch: int = 1024):
+        """test.py:13-24 (clean) / :61-89 (poison): iterate EVERY state's
+        test shard; the poison pass stamps ALL samples with the combined
+        trigger and swaps every label (no target-class filtering for LOAN)."""
+        import torch
+        self.model.load_state_dict(self.global_sd)
+        self.model.eval()
+        correct, count = 0, 0
+        with torch.no_grad():
+            for sx, sy in zip(self.test_x, self.test_y):
+                for i in range(0, len(sy), batch):
+                    x, y = sx[i:i + batch], sy[i:i + batch]
+                    if poisoned:
+                        x = self._stamp(x.clone(), self.values.shape[0] - 1)
+                        y = torch.full_like(y, self.swap)
+                    pred = self.model(x).argmax(1)
+                    correct += int((pred == y).sum())
+                    count += len(y)
+        return 100.0 * correct / max(count, 1)
+
+    def clean_acc(self):
+        return self._eval(False)
+
+    def backdoor_acc(self):
+        return self._eval(True)
+
+
 # ------------------------------------------------------------------- driver
+def _compare_states(train_deltas, torch_deltas, agent_names, to_torch,
+                    global_vars, torch_global_sd):
+    """Shared A/B comparison: per-client submitted-update diffs (max abs vs
+    the torch update's own scale) and the round-end global-state diff."""
+    import jax
+
+    from dba_mod_tpu.models import ModelVars
+
+    deltas_np = jax.device_get(train_deltas)
+    per_client = []
+    for c, name in enumerate(agent_names):
+        jd = to_torch(ModelVars(
+            params=jax.tree_util.tree_map(lambda l: l[c], deltas_np.params),
+            batch_stats=jax.tree_util.tree_map(lambda l: l[c],
+                                               deltas_np.batch_stats)))
+        max_abs, ref_scale = 0.0, 0.0
+        for k, td in torch_deltas[c].items():
+            max_abs = max(max_abs, float(np.abs(jd[k] - td).max()))
+            ref_scale = max(ref_scale, float(np.abs(td).max()))
+        per_client.append({"name": str(name), "max_abs_diff": max_abs,
+                           "ref_scale": ref_scale})
+    g = to_torch(global_vars)
+    g_diff = max(float(np.abs(g[k] - torch_global_sd[k].numpy()).max())
+                 for k in g)
+    return per_client, g_diff
+
+
 def run_ab(overrides: dict, n_rounds: int) -> dict:
     """Run n_rounds through both frameworks; return the comparison report."""
     import jax
@@ -486,7 +863,6 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
     from dba_mod_tpu.fl.experiment import Experiment
     from dba_mod_tpu.fl.selection import select_agents
     from dba_mod_tpu.fl.state import build_client_tasks
-    from dba_mod_tpu.models import ModelVars
     from dba_mod_tpu.ops.triggers import build_pixel_pattern_bank
 
     params = Params.from_dict(overrides)
@@ -499,57 +875,55 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
                   data.train_images, data.train_labels, data.test_images,
                   data.test_labels, bank)
 
+    interval = int(params["aggr_epoch_interval"])
     rounds = []
-    for epoch in range(1, n_rounds + 1):
+    for rnum in range(n_rounds):
+        # the reference round loop advances by the interval (main.py:135);
+        # each round carries one training segment per global epoch
+        epoch = 1 + rnum * interval
         agent_names, _ = select_agents(params, epoch, exp.participants,
                                        exp.benign_names, exp.select_rng)
         slots = np.array([exp.client_slots[n] for n in agent_names], np.int64)
-        tasks = build_client_tasks(params, agent_names, epoch, slots,
-                                   exp.epochs_max, None)
-        plan = build_batch_plan(
-            [exp.client_indices[n] for n in agent_names],
-            [int(e) for e in tasks.num_epochs], int(params["batch_size"]),
-            exp.plan_rng, min_steps=exp.steps_per_epoch,
-            min_epochs=exp.epochs_max)
+        seg_epochs = list(range(epoch, epoch + interval))
+        tasks_list, idx_list, mask_list = [], [], []
+        num_samples = None
+        for ep in seg_epochs:
+            tasks_s = build_client_tasks(params, agent_names, ep, slots,
+                                         exp.epochs_max, None)
+            plan = build_batch_plan(
+                [exp.client_indices[n] for n in agent_names],
+                [int(e) for e in tasks_s.num_epochs],
+                int(params["batch_size"]), exp.plan_rng,
+                min_steps=exp.steps_per_epoch, min_epochs=exp.epochs_max)
+            if num_samples is None:
+                num_samples = plan.num_samples.astype(np.float32)
+            tasks_list.append(tasks_s)
+            idx_list.append(plan.idx)
+            mask_list.append(plan.mask)
         C = len(agent_names)
-        tasks_seq = jax.tree_util.tree_map(lambda l: jnp.asarray(l[None]),
-                                           tasks)
-        idx_seq = jnp.asarray(plan.idx[None])
-        mask_seq = jnp.asarray(plan.mask[None])
+        idx_np, mask_np = np.stack(idx_list), np.stack(mask_list)
+        tasks_seq = jax.tree_util.tree_map(
+            lambda *ls: jnp.asarray(np.stack(ls)), *tasks_list)
         lane = jnp.arange(C, dtype=jnp.int32)
         exp.rng_key, round_key = jax.random.split(exp.rng_key)
         rng_t, rng_a = jax.random.split(round_key)
-        train = exp.engine.train_fn(exp.global_vars, tasks_seq, idx_seq,
-                                    mask_seq, lane, rng_t)
+        train = exp.engine.train_fn(exp.global_vars, tasks_seq,
+                                    jnp.asarray(idx_np),
+                                    jnp.asarray(mask_np), lane, rng_t)
         agg = exp.engine.aggregate_fn(
             exp.global_vars, exp.fg_state, train.deltas, train.fg_grads,
-            train.fg_feature, jnp.asarray(tasks.participant_id),
-            jnp.asarray(plan.num_samples.astype(np.float32)), rng_a)
+            train.fg_feature, jnp.asarray(tasks_list[0].participant_id),
+            jnp.asarray(num_samples), rng_a)
         exp.global_vars = agg.new_vars
         exp.fg_state = agg.new_fg_state
         jax_globals = jax.device_get(exp.engine.global_evals_fn(agg.new_vars))
 
-        torch_deltas = tfl.run_round(epoch, agent_names, plan.idx, plan.mask)
+        torch_deltas = tfl.run_round(seg_epochs, agent_names, idx_np,
+                                     mask_np)
 
-        # ---- compare ----
-        deltas_np = jax.device_get(train.deltas)
-        per_client = []
-        for c in range(C):
-            jd = to_torch(ModelVars(
-                params=jax.tree_util.tree_map(lambda l: l[c],
-                                              deltas_np.params),
-                batch_stats=jax.tree_util.tree_map(
-                    lambda l: l[c], deltas_np.batch_stats)))
-            max_abs, ref_scale = 0.0, 0.0
-            for k, td in torch_deltas[c].items():
-                max_abs = max(max_abs, float(np.abs(jd[k] - td).max()))
-                ref_scale = max(ref_scale, float(np.abs(td).max()))
-            per_client.append({"name": str(agent_names[c]),
-                               "max_abs_diff": max_abs,
-                               "ref_scale": ref_scale})
-        g = to_torch(exp.global_vars)
-        g_diff = max(float(np.abs(g[k] - tfl.global_sd[k].numpy()).max())
-                     for k in g)
+        per_client, g_diff = _compare_states(
+            train.deltas, torch_deltas, agent_names, to_torch,
+            exp.global_vars, tfl.global_sd)
         torch_clean, torch_bd = tfl.clean_acc(), tfl.backdoor_acc()
         rounds.append({
             "epoch": epoch,
@@ -561,6 +935,99 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
             "jax_backdoor_acc": float(jax_globals.poison.acc),
             "torch_backdoor_acc": torch_bd,
             "backdoor_acc_gap": abs(float(jax_globals.poison.acc) - torch_bd),
+        })
+    return {"type": params.type, "rounds": rounds}
+
+
+def run_ab_loan(overrides: dict, n_rounds: int) -> dict:
+    """LOAN A/B: same shape as run_ab, plus the two LOAN-specific shared
+    inputs — the per-step dropout masks (extract_loan_dropout_masks) and the
+    feature-trigger value/mask banks — and the adaptive-poison-LR probe,
+    which each side computes from its OWN global model (loan_train.py:67-75;
+    identical state ⇒ identical accuracy ⇒ identical LR)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dba_mod_tpu.config import Params
+    from dba_mod_tpu.data import build_batch_plan
+    from dba_mod_tpu.fl.experiment import Experiment
+    from dba_mod_tpu.fl.selection import select_agents
+    from dba_mod_tpu.fl.state import build_client_tasks
+    from dba_mod_tpu.ops.triggers import build_feature_trigger_bank
+
+    params = Params.from_dict(overrides)
+    # the mask extraction hardcodes segment 0 and TorchLoanFL replays one
+    # plan per round — a multi-segment LOAN round would compare the wrong
+    # masks and report a phantom parity failure; fail loudly instead
+    assert int(params["aggr_epoch_interval"]) == 1, (
+        "run_ab_loan supports aggr_epoch_interval=1 only")
+    exp = Experiment(params, save_results=False)
+    data = exp.loan_data
+    values, masks_bank = build_feature_trigger_bank(
+        params, {n: i for i, n in enumerate(data.feature_names)},
+        data.train_x[0].shape[-1])
+    tfl = TorchLoanFL(params.raw, loan_state_to_torch(exp.global_vars),
+                      data.train_x, data.train_y, data.test_x, data.test_y,
+                      values, masks_bank)
+
+    rounds = []
+    for epoch in range(1, n_rounds + 1):
+        agent_names, _ = select_agents(params, epoch, exp.participants,
+                                       exp.benign_names, exp.select_rng)
+        slots = np.array([exp.client_slots[n] for n in agent_names], np.int64)
+        # the engine-side probe, exactly as dispatch_round gates it
+        # (fl/experiment.py:383-393)
+        backdoor_acc = None
+        if any(params.adversary_slot_of(n) >= 0 and
+               epoch in params.poison_epochs_for(params.adversary_slot_of(n))
+               for n in agent_names):
+            backdoor_acc = float(exp.engine.backdoor_acc_fn(exp.global_vars))
+        tasks = build_client_tasks(params, agent_names, epoch, slots,
+                                   exp.epochs_max, backdoor_acc)
+        plan = build_batch_plan(
+            [exp.client_indices[n] for n in agent_names],
+            [int(e) for e in tasks.num_epochs], int(params["batch_size"]),
+            exp.plan_rng, min_steps=exp.steps_per_epoch,
+            min_epochs=exp.epochs_max)
+        C, E, S, B = plan.idx.shape
+        tasks_seq = jax.tree_util.tree_map(lambda l: jnp.asarray(l[None]),
+                                           tasks)
+        lane = jnp.arange(C, dtype=jnp.int32)
+        exp.rng_key, round_key = jax.random.split(exp.rng_key)
+        rng_t, rng_a = jax.random.split(round_key)
+        drop0, drop1 = extract_loan_dropout_masks(
+            exp.model_def.module, rng_t, C, E, S, B)
+        train = exp.engine.train_fn(exp.global_vars, tasks_seq,
+                                    jnp.asarray(plan.idx[None]),
+                                    jnp.asarray(plan.mask[None]), lane,
+                                    rng_t)
+        agg = exp.engine.aggregate_fn(
+            exp.global_vars, exp.fg_state, train.deltas, train.fg_grads,
+            train.fg_feature, jnp.asarray(tasks.participant_id),
+            jnp.asarray(plan.num_samples.astype(np.float32)), rng_a)
+        exp.global_vars = agg.new_vars
+        exp.fg_state = agg.new_fg_state
+        jax_globals = jax.device_get(exp.engine.global_evals_fn(agg.new_vars))
+
+        torch_deltas, torch_poison_lr = tfl.run_round(
+            epoch, agent_names, slots, plan.idx, plan.mask, drop0, drop1)
+
+        per_client, g_diff = _compare_states(
+            train.deltas, torch_deltas, agent_names, loan_state_to_torch,
+            exp.global_vars, tfl.global_sd)
+        torch_clean, torch_bd = tfl.clean_acc(), tfl.backdoor_acc()
+        rounds.append({
+            "epoch": epoch,
+            "per_client": per_client,
+            "global_max_abs_diff": g_diff,
+            "jax_clean_acc": float(jax_globals.clean.acc),
+            "torch_clean_acc": torch_clean,
+            "clean_acc_gap": abs(float(jax_globals.clean.acc) - torch_clean),
+            "jax_backdoor_acc": float(jax_globals.poison.acc),
+            "torch_backdoor_acc": torch_bd,
+            "backdoor_acc_gap": abs(float(jax_globals.poison.acc) - torch_bd),
+            "jax_probe_acc": backdoor_acc,
+            "torch_poison_lr": torch_poison_lr,
         })
     return {"type": params.type, "rounds": rounds}
 
@@ -588,6 +1055,24 @@ MNIST_AB_R1 = dict(MNIST_AB,
                    **{"0_poison_epochs": [1, 2, 3, 4],
                       "1_poison_epochs": [1, 3, 4]})
 
+# Blended-loss variant: alpha_loss=0.9 activates the anomaly-evading
+# distance term α·CE + (1-α)·‖w-w_anchor‖ (image_train.py:85-90) that every
+# reference config leaves at α=1 (where the engine skips its fwd+bwd at
+# trace time) — this round proves the term's GRADIENT matches torch.
+MNIST_AB_ALPHA = dict(MNIST_AB_R1, alpha_loss=0.9)
+
+# baseline=True: model-replacement scaling disabled (image_train.py:148).
+MNIST_AB_BASELINE = dict(MNIST_AB_R1, baseline=True)
+
+# aggr_epoch_interval=2 identical-state round: ONE round = segments at
+# epochs (1, 2). Adversary 0 poisons segment 1 then runs BENIGN in segment 2
+# (poison→benign chaining: the benign optimizer's momentum was untouched by
+# the poison segment); adversary 1 poisons both segments (fresh poison
+# optimizer + scheduler each, scaling re-anchored to the segment start,
+# image_train.py:52-54, :166-171).
+MNIST_AB_I2 = dict(MNIST_AB_R1, aggr_epoch_interval=2,
+                   **{"0_poison_epochs": [1, 3], "1_poison_epochs": [1, 2]})
+
 # RFA variant of the identical-state round: the full Weiszfeld pipeline
 # (sample-count alphas, eps-floored distance weights, ftol break, eta·median
 # global step) composed with real poisoned client deltas, cross-framework.
@@ -599,6 +1084,48 @@ MNIST_AB_RFA = dict(MNIST_AB_R1, aggregation_methods="geom_median",
 # server SGD step — composed with real sybil (two-adversary) deltas.
 MNIST_AB_FG = dict(MNIST_AB_R1, aggregation_methods="foolsgold",
                    fg_use_memory=True)
+
+# Tiny-ImageNet identical-state round: the torchvision-style stem (7×7/s2 +
+# max pool), global average pool, and 200-class head compose with the same
+# BN/poison/scaling machinery as CIFAR; 128/4 = 32 rows per client divide
+# batch_size exactly (BN sees no wrap-padding, README quirk table).
+# Single adversary → centralized mode (combined trigger, adv_index −1).
+TINY_AB = dict(
+    **{"type": "tiny-imagenet-200"}, lr=0.05, batch_size=16, epochs=1,
+    no_models=2, number_of_total_participants=4, eta=0.8,
+    aggregation_methods="mean", internal_epochs=1, internal_poison_epochs=2,
+    is_poison=True, synthetic_data=True, synthetic_train_size=128,
+    synthetic_test_size=64, momentum=0.9, decay=0.0005,
+    sampling_dirichlet=False, local_eval=False, random_seed=7,
+    poison_label_swap=3, poisoning_per_batch=4, poison_lr=0.02,
+    poison_step_lr=True, scale_weights_poison=2.0, adversary_list=[0],
+    trigger_num=2, alpha_loss=1.0,
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2]],
+       "1_poison_pattern": [[5, 0], [5, 1], [5, 2]],
+       "0_poison_epochs": [1]})
+
+
+# LOAN: internal_poison_epochs=5 → integral MultiStepLR milestones [1.0, 4.0]
+# fire under the top-of-epoch scheduler step (loan_train.py:90-92); round 1 is
+# identical-state with both adversaries' feature triggers, benign clients, and
+# ×3 scaling active; later rounds exercise the adaptive poison-LR decay
+# (backdoor acc > 20 → lr/5, > 60 → lr/50, loan_train.py:71-75) once the
+# round-1 scaled update plants the backdoor.
+LOAN_AB = dict(
+    type="loan", lr=0.05, poison_lr=0.05, batch_size=64, epochs=4,
+    no_models=4, number_of_total_participants=8, eta=0.8,
+    aggregation_methods="mean", internal_epochs=2, internal_poison_epochs=5,
+    is_poison=True, synthetic_data=True, momentum=0.9, decay=0.0005,
+    sampling_dirichlet=False, local_eval=False, random_seed=7,
+    poison_label_swap=7, poisoning_per_batch=16, poison_step_lr=True,
+    scale_weights_poison=3.0, trigger_num=2, alpha_loss=1.0,
+    adversary_list=["AK", "AL"],
+    **{"0_poison_trigger_names": ["num_tl_120dpd_2m", "num_tl_90g_dpd_24m"],
+       "0_poison_trigger_values": [10, 80],
+       "1_poison_trigger_names": ["pub_rec_bankruptcies", "pub_rec"],
+       "1_poison_trigger_values": [20, 100],
+       "0_poison_epochs": [1, 2, 3], "1_poison_epochs": [1, 3]})
+
 
 # client partitions (256/4 = 64 samples) divide batch_size exactly: BN batch
 # statistics see no wrap-padding on either side (README quirk table row on
@@ -677,6 +1204,28 @@ def main():
     out.write(_fmt_report(dict(
         rep, type="mnist + FoolsGold w/ memory (round 1 identical-state, "
                   "round 2 chains the memory)")))
+    rep = run_ab(dict(MNIST_AB_ALPHA), 1)
+    out.write(_fmt_report(dict(
+        rep, type="mnist + alpha_loss=0.9 (identical-state; blended "
+                  "anomaly-evading distance loss in the poison branch)")))
+    rep = run_ab(dict(MNIST_AB_BASELINE), 1)
+    out.write(_fmt_report(dict(
+        rep, type="mnist + baseline (identical-state; scaling disabled)")))
+    rep = run_ab(dict(MNIST_AB_I2), 1)
+    out.write(_fmt_report(dict(
+        rep, type="mnist + aggr_epoch_interval=2 (identical-state; "
+                  "per-segment re-anchoring, poison→benign chaining)")))
+    rep = run_ab(dict(TINY_AB), 1)
+    out.write(_fmt_report(dict(
+        rep, type="tiny-imagenet-200 (identical-state; centralized "
+                  "combined trigger, imagenet stem + global pool)")))
+    # one 3-round LOAN run serves both sections: round 1 IS the
+    # identical-state round, rounds 2-3 chain the adaptive poison LR
+    loan_rep = run_ab_loan(dict(LOAN_AB), 3)
+    out.write(_fmt_report(dict(loan_rep, rounds=loan_rep["rounds"][:1],
+                               type="loan (identical-state; "
+                               "shared dropout masks, feature triggers, "
+                               "scheduler-first MultiStepLR)")))
     out.write(
         "\n## Multi-round runs (statistical parity)\n\n"
         "Each framework integrates its own f32 rounding across rounds "
@@ -690,6 +1239,14 @@ def main():
                         for r in rep["rounds"])
         out.write(f"\nWorst accuracy gap: {worst_gap:.3f}% "
                   f"(bar: 1%).\n\n")
+    out.write(_fmt_report(loan_rep))
+    lrs = [r["torch_poison_lr"] for r in loan_rep["rounds"]]
+    worst_gap = max(max(r["clean_acc_gap"], r["backdoor_acc_gap"])
+                    for r in loan_rep["rounds"])
+    out.write(f"\nWorst accuracy gap: {worst_gap:.3f}% (bar: 1%). "
+              f"Adaptive poison LR per round: {lrs} (base "
+              f"{LOAN_AB['poison_lr']}; a decayed value means the "
+              f"backdoor-accuracy rule fired, loan_train.py:71-75).\n\n")
     with open("PARITY_AB.md", "w") as f:
         f.write(out.getvalue())
     print(out.getvalue())

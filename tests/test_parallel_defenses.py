@@ -44,6 +44,22 @@ CIFAR8 = dict(
        "0_poison_epochs": [1, 2]})
 
 
+LOAN8 = dict(
+    type="loan", lr=0.05, poison_lr=0.05, batch_size=64, epochs=2,
+    no_models=8, number_of_total_participants=12, eta=0.8,
+    aggregation_methods="mean", internal_epochs=1, internal_poison_epochs=2,
+    is_poison=True, synthetic_data=True, momentum=0.9, decay=0.0005,
+    sampling_dirichlet=False, local_eval=True, poison_label_swap=7,
+    poisoning_per_batch=16, poison_step_lr=True, scale_weights_poison=2.0,
+    trigger_num=2, alpha_loss=1.0, random_seed=1,
+    adversary_list=["AK", "AL"],
+    **{"0_poison_trigger_names": ["num_tl_120dpd_2m", "num_tl_90g_dpd_24m"],
+       "0_poison_trigger_values": [10, 80],
+       "1_poison_trigger_names": ["pub_rec_bankruptcies", "pub_rec"],
+       "1_poison_trigger_values": [20, 100],
+       "0_poison_epochs": [1, 2], "1_poison_epochs": [2]})
+
+
 def _pair(cfg):
     e1 = Experiment(Params.from_dict(cfg), save_results=False)
     e8 = Experiment(Params.from_dict(dict(cfg, num_devices=8)),
@@ -80,6 +96,28 @@ def test_cifar_bn_round_on_mesh_matches_single_device():
     # the sharded local battery produced rows for every client
     assert len({row[0] for row in e8.recorder.test_result
                 if row[0] != "global"}) == 8
+
+
+def test_loan_round_on_mesh_matches_single_device():
+    """LOAN on the sharded clients axis — the one workload whose mesh path
+    had no coverage: ragged per-state shards fetched by (slot, idx) gathers,
+    feature-trigger stamping, lane-keyed dropout streams, and the blocking
+    adaptive poison-LR probe (round 2 probes the round-1 planted backdoor,
+    loan_train.py:67-75) must reproduce single-device numerics."""
+    e1, e8 = _pair(LOAN8)
+    for ep in (1, 2):
+        r1 = e1.run_round(ep)
+        r8 = e8.run_round(ep)
+        assert np.isfinite(r8["global_acc"])
+        assert abs(r1["global_acc"] - r8["global_acc"]) < 1.0
+        assert abs(r1["backdoor_acc"] - r8["backdoor_acc"]) < 1.0
+    # MLP matmul reductions reorder between the one-device [8·B] batch and
+    # the per-device [B] kernels; two rounds of drift stay tiny
+    np.testing.assert_allclose(_flat(e1.global_vars.params),
+                               _flat(e8.global_vars.params), atol=1e-4)
+    # every one of round 2's 8 sharded clients produced its local row
+    assert len({row[0] for row in e8.recorder.test_result
+                if row[0] != "global" and row[1] == 2}) == 8
 
 
 @pytest.mark.parametrize("method", ["foolsgold", "geom_median"])

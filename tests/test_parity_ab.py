@@ -94,6 +94,89 @@ def test_mnist_rfa_identical_state_round():
     _check_accuracy(rep)
 
 
+def test_mnist_blended_loss_and_baseline_variants():
+    """Two attack-machinery branches no reference config exercises but the
+    framework must carry: (a) alpha_loss=0.9 activates the anomaly-evading
+    α·CE + (1-α)·‖w-w_anchor‖ loss (image_train.py:85-90) in the POISON
+    branch only — its gradient (a unit vector scaled by the weight, with the
+    torch.norm zero-subgradient on the first batch where w == w_anchor) must
+    match torch; (b) baseline=True disables model-replacement scaling
+    (image_train.py:148). Both identical-state rounds stay at float
+    roundoff (measured 2.4e-6 / 3e-8)."""
+    from benchmarks.parity_ab import MNIST_AB_ALPHA, MNIST_AB_BASELINE
+    for cfg, tol in ((MNIST_AB_ALPHA, 2e-5), (MNIST_AB_BASELINE, 1e-6)):
+        rep = run_ab(dict(cfg), 1)
+        r = rep["rounds"][0]
+        for pc in r["per_client"]:
+            assert pc["max_abs_diff"] <= tol, (cfg["alpha_loss"], pc)
+        assert r["global_max_abs_diff"] <= tol, r
+        _check_accuracy(rep)
+
+
+def test_mnist_interval2_identical_state_round():
+    """aggr_epoch_interval=2 cross-framework: one round = two chained
+    training segments (epochs 1 and 2) with the reference's per-segment
+    machinery — the distance/scaling anchor re-snapshots to the client state
+    at each segment start (image_train.py:52-54, :166-171), the poison
+    optimizer + MultiStepLR are rebuilt per poison segment, and the benign
+    optimizer (with its momentum) persists across segments. Adversary 0
+    poisons segment 1 then trains BENIGN in segment 2; adversary 1 poisons
+    both. From identical state the whole-round submitted deltas agree to
+    float roundoff (measured ≤3.5e-6 over 2 chained segments)."""
+    from benchmarks.parity_ab import MNIST_AB_I2
+    rep = run_ab(dict(MNIST_AB_I2), 1)
+    r = rep["rounds"][0]
+    for pc in r["per_client"]:
+        assert pc["max_abs_diff"] <= 5e-5, pc
+    assert r["global_max_abs_diff"] <= 5e-5, r
+    _check_accuracy(rep)
+
+
+def test_tiny_imagenet_ab_parity():
+    """Tiny-ImageNet ResNet-18 (imagenet stem + global pool, 200 classes,
+    centralized combined trigger): identical-state round. Forward parity is
+    tight (measured: eval fwd ≤1.1e-6, train fwd ≤5.5e-6, BN stats ≤7e-7 —
+    a state-mapping bug would show here), but the deeper/wider net amplifies
+    the same conv-summation ReLU-gate chaos as CIFAR through 2 epochs of SGD
+    + ×2 scaling (measured delta envelope ~1.4e-1 on O(2.7) updates), so the
+    delta bound is a gross-divergence tripwire and the semantic claim lives
+    in the accuracy bar."""
+    from benchmarks.parity_ab import TINY_AB
+    rep = run_ab(dict(TINY_AB), 1)
+    r = rep["rounds"][0]
+    for pc in r["per_client"]:
+        assert pc["max_abs_diff"] <= 0.4, pc
+    assert r["global_max_abs_diff"] <= 0.15, r
+    _check_accuracy(rep)
+
+
+def test_loan_ab_parity_with_shared_dropout_masks():
+    """LOAN cross-framework: the dropout masks the flax engine draws are
+    extracted from its per-step RNG keys (probe forward + captured Dropout
+    intermediates) and fed to the torch twin's mask-consuming Dropout, making
+    the one framework-specific RNG stream a SHARED input like the batch
+    plans. Covers feature-value triggers, the top-of-epoch MultiStepLR step
+    (loan_train.py:90-92), model-replacement scaling, and the adaptive
+    poison-LR decay (loan_train.py:71-75) — round 1 is identical-state, and
+    rounds 2-3 must run with the decayed LR (backdoor acc 100 → lr/50) on
+    BOTH sides to stay tight. The 91→46→23→9 MLP has a stable summation
+    order, so unlike the conv models every round stays at float roundoff
+    (measured ≤1.8e-7)."""
+    from benchmarks.parity_ab import LOAN_AB, run_ab_loan
+    rep = run_ab_loan(dict(LOAN_AB), 3)
+    for r in rep["rounds"]:
+        for pc in r["per_client"]:
+            assert pc["max_abs_diff"] <= 5e-6, (r["epoch"], pc)
+        assert r["global_max_abs_diff"] <= 5e-6, r
+    _check_accuracy(rep)
+    # the adaptive-LR rule must actually fire: round 1 plants the backdoor
+    # (scaled ×3 update), so rounds 2+ probe at acc > 60 → lr/50
+    lrs = [r["torch_poison_lr"] for r in rep["rounds"]]
+    assert lrs[0] == LOAN_AB["poison_lr"], lrs
+    assert any(lr is not None and lr < LOAN_AB["poison_lr"] / 10
+               for lr in lrs[1:]), lrs
+
+
 def test_mnist_foolsgold_identical_state_rounds():
     """FoolsGold cross-framework: cosine-similarity reweighting over the
     [-2] parameter's accumulated gradient (sybil adversaries 0/1 share a
