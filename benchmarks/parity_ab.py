@@ -1091,7 +1091,7 @@ MNIST_AB_FG = dict(MNIST_AB_R1, aggregation_methods="foolsgold",
 # batch_size exactly (BN sees no wrap-padding, README quirk table).
 # Single adversary → centralized mode (combined trigger, adv_index −1).
 TINY_AB = dict(
-    **{"type": "tiny-imagenet-200"}, lr=0.05, batch_size=16, epochs=1,
+    type="tiny-imagenet-200", lr=0.05, batch_size=16, epochs=1,
     no_models=2, number_of_total_participants=4, eta=0.8,
     aggregation_methods="mean", internal_epochs=1, internal_poison_epochs=2,
     is_poison=True, synthetic_data=True, synthetic_train_size=128,

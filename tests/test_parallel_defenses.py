@@ -98,6 +98,40 @@ def test_cifar_bn_round_on_mesh_matches_single_device():
                 if row[0] != "global"}) == 8
 
 
+TINY8 = dict(
+    type="tiny-imagenet-200", lr=0.1, batch_size=4, epochs=1,
+    no_models=8, number_of_total_participants=8, eta=0.8,
+    aggregation_methods="mean", internal_epochs=1, internal_poison_epochs=1,
+    is_poison=True, synthetic_data=True, synthetic_train_size=64,
+    synthetic_test_size=64, momentum=0.9, decay=0.0005,
+    sampling_dirichlet=False, local_eval=False, poison_label_swap=3,
+    poisoning_per_batch=2, poison_lr=0.05, scale_weights_poison=2.0,
+    adversary_list=[0], trigger_num=1, alpha_loss=1.0, random_seed=1,
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2]],
+       "0_poison_epochs": [1]})
+
+
+def test_tiny_round_on_mesh_matches_single_device():
+    """Tiny-ImageNet on the sharded clients axis — completes the
+    workload×mesh matrix (MNIST/CIFAR-BN/LOAN covered above): the imagenet
+    stem + max pool + global-average-pool graph with batch_stats trees
+    through GSPMD. One round, same chaos rationale as the CIFAR-BN test."""
+    e1, e8 = _pair(TINY8)
+    r1 = e1.run_round(1)
+    r8 = e8.run_round(1)
+    assert np.isfinite(r8["global_acc"])
+    # measured: max 6.4e-3 with 4 ppm of elements above 5e-3 (batch-4 BN
+    # statistics amplify the reduction-order chaos harder than CIFAR's
+    # batch-8); 2e-2 is the gross-divergence tripwire
+    np.testing.assert_allclose(_flat(e1.global_vars.params),
+                               _flat(e8.global_vars.params), atol=2e-2)
+    np.testing.assert_allclose(_flat(e1.global_vars.batch_stats),
+                               _flat(e8.global_vars.batch_stats), atol=5e-3)
+    # 64-sample eval ⇒ 1.6% per sample
+    assert abs(r1["global_acc"] - r8["global_acc"]) < 4.0
+    assert abs(r1["backdoor_acc"] - r8["backdoor_acc"]) < 4.0
+
+
 def test_loan_round_on_mesh_matches_single_device():
     """LOAN on the sharded clients axis — the one workload whose mesh path
     had no coverage: ragged per-state shards fetched by (slot, idx) gathers,
