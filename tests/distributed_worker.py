@@ -12,6 +12,10 @@ import sys
 def main():
     process_id = int(sys.argv[1])
     coordinator = sys.argv[2]
+    # optional aggregation rule (default FedAvg); "geom_median" exercises
+    # RFA's per-iteration Weiszfeld distance collectives across the
+    # process boundary (DCN path)
+    method = sys.argv[3] if len(sys.argv) > 3 else "mean"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=4").strip()
@@ -30,7 +34,7 @@ def main():
     params = Params.from_dict(dict(
         type="mnist", lr=0.1, batch_size=8, epochs=2, no_models=8,
         number_of_total_participants=8, eta=0.8,
-        aggregation_methods="mean", internal_epochs=1,
+        aggregation_methods=method, internal_epochs=1,
         internal_poison_epochs=2, is_poison=True, synthetic_data=True,
         synthetic_train_size=128, synthetic_test_size=64, momentum=0.9,
         decay=0.0005, sampling_dirichlet=False, local_eval=True,

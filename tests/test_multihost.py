@@ -22,6 +22,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 WORKER = Path(__file__).parent / "distributed_worker.py"
 
 
@@ -33,7 +35,11 @@ def _free_port():
     return port
 
 
-def test_two_process_round():
+@pytest.mark.parametrize("method", ["mean", "geom_median"])
+def test_two_process_round(method):
+    """FedAvg proves the bootstrap + placement path; geom_median (RFA)
+    additionally runs the per-iteration Weiszfeld distance collectives
+    across the process boundary."""
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     env = {k: v for k, v in os.environ.items()
@@ -42,7 +48,7 @@ def test_two_process_round():
     env["TF_CPP_MIN_LOG_LEVEL"] = "3"
     env["PYTHONPATH"] = str(WORKER.parent.parent)  # repo root import
     procs = [subprocess.Popen(
-        [sys.executable, str(WORKER), str(pid), coord],
+        [sys.executable, str(WORKER), str(pid), coord, method],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd=str(WORKER.parent.parent))
         for pid in (0, 1)]
