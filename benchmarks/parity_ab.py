@@ -26,8 +26,8 @@ Known cross-framework deviations (documented in README quirk table):
   excluded from state comparison and from FedAvg accumulation.
 
 Scope — all four workloads: MNIST (all three aggregators — FedAvg, RFA
-geometric median, FoolsGold with memory — plus an aggr_epoch_interval=2
-round with per-segment re-anchoring), CIFAR-BN (FedAvg),
+geometric median, FoolsGold with memory — plus aggr_epoch_interval=2,
+blended-loss/baseline, and DP-noise lanes), CIFAR-BN (FedAvg),
 Tiny-ImageNet (FedAvg, centralized combined trigger, imagenet stem +
 global pool), and LOAN (FedAvg, feature triggers, scheduler-steps-first
 MultiStepLR, adaptive poison LR). LOAN
@@ -920,6 +920,26 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
 
         torch_deltas = tfl.run_round(seg_epochs, agent_names, idx_np,
                                      mask_np)
+        if bool(params["diff_privacy"]):
+            # DP noise is random — like the LOAN dropout masks it becomes a
+            # SHARED input: recompute the exact noise tree the engine drew
+            # (dp_noise_like(rng_a, state, sigma), ops/aggregation.py:76-79)
+            # and add it to the torch global. What stays under test is the
+            # reference's composition: σ-scaled Gaussian per state entry,
+            # added ONCE after the eta/no_models sum, NOT eta-scaled
+            # (helper.py:186-191, :253-254). Only FedAvg's noise derivation
+            # is mirrored here — RFA draws inside the Weiszfeld update (and
+            # discards it on norm rejection) and FoolsGold applies none; a
+            # DP lane for those would silently compare the wrong noise, so
+            # fail loudly instead.
+            assert params.raw.get("aggregation_methods", "mean") == "mean", (
+                "the A/B DP lane supports FedAvg only")
+            import torch
+            from dba_mod_tpu.ops.aggregation import dp_noise_like
+            noise = to_torch(dp_noise_like(rng_a, exp.global_vars,
+                                           float(params["sigma"])))
+            for k in tfl.global_sd:
+                tfl.global_sd[k] = tfl.global_sd[k] + torch.tensor(noise[k])
 
         per_client, g_diff = _compare_states(
             train.deltas, torch_deltas, agent_names, to_torch,
@@ -1054,6 +1074,10 @@ MNIST_AB = dict(
 MNIST_AB_R1 = dict(MNIST_AB,
                    **{"0_poison_epochs": [1, 2, 3, 4],
                       "1_poison_epochs": [1, 3, 4]})
+
+# DP-noise variant: FedAvg + differential-privacy Gaussian noise; the noise
+# tree is a shared input (see run_ab), the composition ordering is under test.
+MNIST_AB_DP = dict(MNIST_AB_R1, diff_privacy=True, sigma=0.01)
 
 # Blended-loss variant: alpha_loss=0.9 activates the anomaly-evading
 # distance term α·CE + (1-α)·‖w-w_anchor‖ (image_train.py:85-90) that every
@@ -1204,6 +1228,10 @@ def main():
     out.write(_fmt_report(dict(
         rep, type="mnist + FoolsGold w/ memory (round 1 identical-state, "
                   "round 2 chains the memory)")))
+    rep = run_ab(dict(MNIST_AB_DP), 1)
+    out.write(_fmt_report(dict(
+        rep, type="mnist + differential-privacy noise (identical-state; "
+                  "shared noise tree, composition ordering under test)")))
     rep = run_ab(dict(MNIST_AB_ALPHA), 1)
     out.write(_fmt_report(dict(
         rep, type="mnist + alpha_loss=0.9 (identical-state; blended "

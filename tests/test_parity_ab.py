@@ -94,6 +94,22 @@ def test_mnist_rfa_identical_state_round():
     _check_accuracy(rep)
 
 
+def test_mnist_dp_noise_identical_state_round():
+    """FedAvg + differential-privacy noise cross-framework: the Gaussian
+    noise tree is recomputed from the engine's own rng and added on the
+    torch side too (a shared input, like the LOAN dropout masks), so what
+    the round tests is the reference's DP composition — σ-scaled noise per
+    state entry added ONCE after the eta/no_models sum, not eta-scaled
+    (helper.py:186-191, :253-254). Bit-tight (measured 1.5e-8 global)."""
+    from benchmarks.parity_ab import MNIST_AB_DP
+    rep = run_ab(dict(MNIST_AB_DP), 1)
+    r = rep["rounds"][0]
+    for pc in r["per_client"]:
+        assert pc["max_abs_diff"] <= 1e-6, pc
+    assert r["global_max_abs_diff"] <= 1e-6, r
+    _check_accuracy(rep)
+
+
 def test_mnist_blended_loss_and_baseline_variants():
     """Two attack-machinery branches no reference config exercises but the
     framework must carry: (a) alpha_loss=0.9 activates the anomaly-evading
