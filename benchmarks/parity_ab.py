@@ -1168,6 +1168,15 @@ CIFAR_AB = dict(
        "0_poison_epochs": [1, 2]})
 
 
+# CIFAR-BN + FoolsGold: the defenses×BN cell of the A/B matrix. FoolsGold
+# aggregates named parameters only — BN running stats stay at the global's
+# values on both sides (helper.py:286-290 steps an optimizer over
+# named_parameters; fl/rounds.py:203-206 keeps global batch_stats) — and the
+# [-2]-parameter similarity feature is the fc weight in both frameworks.
+CIFAR_AB_FG = dict(CIFAR_AB, aggregation_methods="foolsgold",
+                   fg_use_memory=True)
+
+
 def _fmt_report(rep: dict) -> str:
     lines = [f"### {rep['type']}", "",
              "| round | max per-client Δ diff | Δ scale | global diff | "
@@ -1247,6 +1256,10 @@ def main():
     out.write(_fmt_report(dict(
         rep, type="tiny-imagenet-200 (identical-state; centralized "
                   "combined trigger, imagenet stem + global pool)")))
+    rep = run_ab(dict(CIFAR_AB_FG), 2)
+    out.write(_fmt_report(dict(
+        rep, type="cifar + FoolsGold w/ memory (BN stats stay global; "
+                  "round 2 chains the memory)")))
     # one 3-round LOAN run serves both sections: round 1 IS the
     # identical-state round, rounds 2-3 chain the adaptive poison LR
     loan_rep = run_ab_loan(dict(LOAN_AB), 3)

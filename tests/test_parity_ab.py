@@ -193,6 +193,23 @@ def test_loan_ab_parity_with_shared_dropout_masks():
                for lr in lrs[1:]), lrs
 
 
+def test_cifar_foolsgold_bn_rounds():
+    """FoolsGold on the BN ResNet — the defenses×BN cell: the server step
+    aggregates NAMED PARAMETERS only, so BN running stats keep the global's
+    values on both sides (helper.py:286-290 / fl/rounds.py:203-206), the
+    [-2]-parameter similarity feature is the fc weight in both frameworks,
+    and round 2 chains the id-keyed memory. Same conv-chaos envelope as the
+    FedAvg CIFAR round; accuracies exact."""
+    from benchmarks.parity_ab import CIFAR_AB_FG
+    rep = run_ab(dict(CIFAR_AB_FG), 2)
+    for r in rep["rounds"]:
+        for pc in r["per_client"]:
+            # measured ≤2.5e-2 (PARITY_AB.md); gross-divergence tripwire
+            assert pc["max_abs_diff"] <= 0.1, (r["epoch"], pc)
+        assert r["global_max_abs_diff"] <= 0.05, r
+    _check_accuracy(rep)
+
+
 def test_mnist_foolsgold_identical_state_rounds():
     """FoolsGold cross-framework: cosine-similarity reweighting over the
     [-2] parameter's accumulated gradient (sybil adversaries 0/1 share a
