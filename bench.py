@@ -219,7 +219,14 @@ def main() -> int:
     out = {"metric": "cifar10_fl_rounds_per_sec",
            "value": round(rounds_per_sec, 4),
            "unit": "rounds/sec",
-           "vs_baseline": round(vs, 2)}
+           "vs_baseline": round(vs, 2),
+           "baseline_note": (
+               "vs reference-style sequential torch loop on this host's "
+               "single CPU core (benchmarks/torch_reference.py) — the only "
+               "runnable reference form in this zero-egress GPU-less image; "
+               "NOT the north-star PyTorch-GPU denominator" if base else
+               "baseline skipped (--skip-baseline, no cache); vs_baseline "
+               "is a 1.0 placeholder, not a measurement")}
 
     if not args.no_phases:
         try:

@@ -712,6 +712,13 @@ class TorchLoanFL:
         self.values = torch.tensor(value_bank)  # [K, F]; row K-1 combined
         self.masks = torch.tensor(mask_bank)
         self.swap = int(raw["poison_label_swap"])
+        # run_round trains with plain CE only; the reference LOAN poison
+        # branch blends alpha_loss*CE + (1-alpha_loss)*distance
+        # (loan_train.py:117-121). Fail loudly rather than report a phantom
+        # parity mismatch if a future lane sets alpha_loss != 1.
+        assert float(raw.get("alpha_loss", 1.0)) == 1.0, (
+            "TorchLoanFL only implements alpha_loss=1.0 (plain CE); the "
+            "blended distance loss is not wired on the LOAN torch twin")
 
     def _adv_of(self, name, epoch):
         return _adv_of(self.raw, name, epoch)
