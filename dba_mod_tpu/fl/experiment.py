@@ -70,6 +70,13 @@ class RoundInFlight:
     tasks_list: List[Any]
     mask_list: List[Any]
     payload: Any                 # device trees handed to jax.device_get
+    # Post-round state handles + host RNG snapshots, captured at dispatch
+    # time: under pipelining, by the time round N finalizes the experiment's
+    # live attributes already belong to round N+1, so checkpoints must save
+    # these captured values, not the live ones.
+    vars_after: Any = None       # global ModelVars after this round
+    fg_after: Any = None         # FoolsGoldState after this round
+    rng_after: Optional[Dict[str, Any]] = None
 
 
 class Experiment:
@@ -111,6 +118,7 @@ class Experiment:
         init_rng = jax.random.key(seed)
         self.global_vars = self.model_def.init_vars(init_rng)
         self.start_epoch = 1
+        self._resume_aux: Optional[Dict[str, Any]] = None
         if params["resumed_model"]:
             path = (Path(str(params.get("checkpoint_dir", "saved_models")))
                     / str(params["resumed_model_name"]))
@@ -118,8 +126,25 @@ class Experiment:
                 path, self.global_vars)
             self.start_epoch = saved_epoch + 1
             self.params.raw["lr"] = saved_lr
-            logger.info("resumed %s: lr=%s start_epoch=%d", path, saved_lr,
-                        self.start_epoch)
+            # full-state sidecar, when the checkpoint has one (save_model
+            # runs write it; pretrain checkpoints don't — model-only resume
+            # is the reference behavior, image_helper.py:56-67)
+            self._resume_aux = ckpt.load_aux_state(path)
+            if (self._resume_aux is not None
+                    and int(self._resume_aux["epoch"]) != saved_epoch):
+                # a crash between the (synchronous) sidecar write and the
+                # async orbax commit can leave the sidecar one round ahead
+                # of the model — restoring it would replay round N with
+                # round N+1's RNG/memory. Fall back to model-only resume.
+                logger.warning(
+                    "resume sidecar is for epoch %d but the model "
+                    "checkpoint is epoch %d — discarding the sidecar "
+                    "(model-only resume; FoolsGold memory and RNG streams "
+                    "restart)", int(self._resume_aux["epoch"]), saved_epoch)
+                self._resume_aux = None
+            logger.info("resumed %s: lr=%s start_epoch=%d aux=%s", path,
+                        saved_lr, self.start_epoch,
+                        self._resume_aux is not None)
 
         # clients mesh: 0 → single-device; -1 → all visible devices; n → n
         nd = int(params.get("num_devices", 0))
@@ -173,6 +198,31 @@ class Experiment:
         # were fully-masked no-ops (tests/test_fl_integration.py).
         self.dynamic_steps = bool(params.get("dynamic_steps", False))
         self._warmed_buckets: set = set()
+        self._apply_resume_aux()
+
+    def _apply_resume_aux(self):
+        """Restore the full-state sidecar loaded during resume: FoolsGold
+        memory, best-val loss, and every RNG stream — so a killed-and-resumed
+        run continues the uninterrupted trajectory exactly (the reference
+        cannot: helper.py:545-549 is RAM-only)."""
+        aux = self._resume_aux
+        if not aux:
+            return
+        self.select_rng.setstate(aux["select_rng"])
+        self.plan_rng.set_state(aux["plan_rng"])
+        self.rng_key = jax.random.wrap_key_data(jnp.asarray(aux["rng_key"]))
+        self.best_loss = float(aux["best_loss"])
+        self.last_backdoor_acc = aux.get("last_backdoor_acc")
+        mem = jnp.asarray(aux["fg_memory"])
+        if mem.shape != self.fg_state.memory.shape:
+            raise ValueError(
+                f"resume sidecar FoolsGold memory shape {mem.shape} does not "
+                f"match this run's {self.fg_state.memory.shape} — the "
+                "checkpoint belongs to a different participant set or model")
+        self.fg_state = self.fg_state._replace(memory=mem)
+        if self.mesh is not None:
+            from dba_mod_tpu.parallel.mesh import replicate_for_mesh
+            self.fg_state = replicate_for_mesh(self.mesh, self.fg_state)
 
     # ------------------------------------------------------------------ data
     def _load_data_and_partition(self, seed: int):
@@ -471,7 +521,9 @@ class Experiment:
             return RoundInFlight(
                 epoch=epoch, t0=t0, seg_epochs=seg_epochs,
                 agent_names=agent_names, adv_names=adv_names,
-                tasks_list=tasks_list, mask_list=mask_list, payload=payload)
+                tasks_list=tasks_list, mask_list=mask_list, payload=payload,
+                vars_after=new_vars, fg_after=new_fg,
+                rng_after=self._snapshot_rng())
 
         train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
                                        rng_train)
@@ -505,7 +557,17 @@ class Experiment:
         return RoundInFlight(epoch=epoch, t0=t0, seg_epochs=seg_epochs,
                              agent_names=agent_names, adv_names=adv_names,
                              tasks_list=tasks_list, mask_list=mask_list,
-                             payload=payload)
+                             payload=payload, vars_after=self.global_vars,
+                             fg_after=self.fg_state,
+                             rng_after=self._snapshot_rng())
+
+    def _snapshot_rng(self) -> Dict[str, Any]:
+        """Host snapshot of every RNG stream a round consumes, taken right
+        after dispatch consumed them — the state a resumed run needs to
+        replay round N+1 onward exactly (tests/test_full_state_resume.py)."""
+        return {"select_rng": self.select_rng.getstate(),
+                "plan_rng": self.plan_rng.get_state(),
+                "rng_key": np.asarray(jax.random.key_data(self.rng_key))}
 
     def finalize_round(self, fl: RoundInFlight) -> Dict[str, Any]:
         (locals_, globals_, metrics, delta_norms, wv, alpha,
@@ -736,49 +798,88 @@ class Experiment:
         rec.save(self.is_poison_run)
 
     # ------------------------------------------------------------------- run
-    def save_model(self, epoch: int):
+    def save_model(self, epoch: int, fl: Optional[RoundInFlight] = None,
+                   async_save: bool = False):
+        """Checkpoint the round's post-aggregation state. With `fl`, saves
+        the state captured at that round's dispatch (required under
+        pipelining — the live attributes already belong to the next round);
+        `async_save` routes through orbax's AsyncCheckpointer so the commit
+        overlaps the next round's compute (run() waits before returning)."""
         params = self.params
         if not params["save_model"] or self.folder is None:
             return
+        model_vars = fl.vars_after if fl is not None else self.global_vars
+        fg_state = fl.fg_after if fl is not None else self.fg_state
+        rng = fl.rng_after if fl is not None else self._snapshot_rng()
         path = self.folder / "model_last.pt.tar"
-        ckpt.save_checkpoint(path, self.global_vars, epoch,
-                             float(params["lr"]))
+        lr = float(params["lr"])
+        written = [path]
+        ckpt.save_checkpoint(path, model_vars, epoch, lr,
+                             async_save=async_save)
         if epoch in list(params["save_on_epochs"]):
-            ckpt.save_checkpoint(Path(str(path) + f".epoch_{epoch}"),
-                                 self.global_vars, epoch,
-                                 float(params["lr"]))
+            p = Path(str(path) + f".epoch_{epoch}")
+            ckpt.save_checkpoint(p, model_vars, epoch, lr,
+                                 async_save=async_save)
+            written.append(p)
         # best-val snapshot whenever the global eval loss improves
         # (helper.py:433-435, called with epoch_loss from main.py:233)
         if self.last_global_loss < self.best_loss:
-            ckpt.save_checkpoint(Path(str(path) + ".best"),
-                                 self.global_vars, epoch,
-                                 float(params["lr"]))
+            p = Path(str(path) + ".best")
+            ckpt.save_checkpoint(p, model_vars, epoch, lr,
+                                 async_save=async_save)
+            written.append(p)
             self.best_loss = self.last_global_loss
+        # full-state sidecar (deviation, documented in checkpoint.py): the
+        # reference loses FoolsGold memory / best loss / RNG position on
+        # restart; we persist them so resume replays the exact trajectory.
+        # Every snapshot gets one — resuming from .epoch_N/.best must not
+        # silently reset the defense. One writer on multi-process.
+        mem = fg_state.memory
+        if jax.process_index() == 0 and (jax.process_count() == 1
+                                         or mem.is_fully_addressable):
+            aux = {"epoch": int(epoch),
+                   "fg_memory": np.asarray(mem),
+                   "best_loss": float(self.best_loss),
+                   "last_backdoor_acc": self.last_backdoor_acc,
+                   **rng}
+            for p in written:
+                ckpt.save_aux_state(p, aux)
 
     def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
         last: Dict[str, Any] = {}
         end = epochs if epochs is not None else int(self.params["epochs"])
         profile_dir = str(self.params.get("profile_dir", "") or "")
         # pipeline_rounds: overlap round N's host fetch/record with round
-        # N+1's device compute (depth 1). Skipped when per-epoch checkpoints
-        # or profiling need rounds to complete in program order.
-        if (bool(self.params.get("pipeline_rounds", False))
-                and not profile_dir and not self.params["save_model"]):
+        # N+1's device compute (depth 1). Checkpoints ride orbax async saves
+        # — save_model(fl=...) uses the state captured at dispatch, and
+        # AsyncCheckpointer serializes commits, so per-epoch checkpoints
+        # land in program order (tests/test_async_checkpoint.py). Only
+        # profiling still forces sequential rounds (a trace needs one
+        # round's dispatch+fetch alone on the timeline).
+        if bool(self.params.get("pipeline_rounds", False)) and not profile_dir:
             def finalize_and_log(fl):
                 r = self.finalize_round(fl)
+                self.save_model(fl.epoch, fl=fl, async_save=True)
                 logger.info("epoch %d done in %.2fs acc=%.2f backdoor=%s",
                             r["epoch"], r["round_time"], r["global_acc"],
                             r["backdoor_acc"])
                 return r
 
             pending: Optional[RoundInFlight] = None
-            for epoch in range(self.start_epoch, end + 1, self.interval):
-                fl = self.dispatch_round(epoch)
+            try:
+                for epoch in range(self.start_epoch, end + 1, self.interval):
+                    fl = self.dispatch_round(epoch)
+                    if pending is not None:
+                        last = finalize_and_log(pending)
+                    pending = fl
                 if pending is not None:
                     last = finalize_and_log(pending)
-                pending = fl
-            if pending is not None:
-                last = finalize_and_log(pending)
+            finally:
+                # even on a mid-run exception, the in-flight async commit
+                # must land — force=True already deleted the previous
+                # model_last, so abandoning the commit would lose the
+                # newest checkpoint entirely
+                ckpt.wait_for_async_saves()
             return last
         for epoch in range(self.start_epoch, end + 1, self.interval):
             if profile_dir and epoch == self.start_epoch + self.interval:

@@ -1,0 +1,68 @@
+"""Async checkpointing composes with round pipelining (VERDICT r4 #4): the
+pipelined run() path no longer degrades to sequential when save_model is on —
+orbax AsyncCheckpointer commits in the background while the next round
+computes, and commits are serialized, so per-epoch checkpoints land in
+program order with the state captured at each round's dispatch."""
+import jax
+import numpy as np
+
+from dba_mod_tpu import checkpoint as ckpt
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.experiment import Experiment
+
+CFG = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=4, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False, random_seed=3,
+    save_model=True, save_on_epochs=[1, 2, 3, 4], pipeline_rounds=True)
+
+
+def test_pipelined_checkpoints_land_in_program_order(tmp_path):
+    e = Experiment(Params.from_dict(CFG), save_results=False)
+    e.folder = tmp_path
+    last = e.run(4)
+    assert last["epoch"] == 4
+
+    like = e.model_def.init_vars(jax.random.key(0))
+    # every per-epoch snapshot exists and stores its own epoch
+    snaps = {}
+    for ep in (1, 2, 3, 4):
+        mv, saved_ep, _ = ckpt.load_checkpoint(
+            tmp_path / f"model_last.pt.tar.epoch_{ep}", like)
+        assert saved_ep == ep
+        snaps[ep] = mv
+    # model_last holds the FINAL round (commits serialized in program order —
+    # an out-of-order commit would leave an earlier round here)
+    mv_last, saved_ep, _ = ckpt.load_checkpoint(
+        tmp_path / "model_last.pt.tar", like)
+    assert saved_ep == 4
+    for a, b in zip(jax.tree_util.tree_leaves(mv_last.params),
+                    jax.tree_util.tree_leaves(snaps[4].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # each epoch's snapshot is the state AFTER that round, not a stale copy:
+    # under pipelining the live attrs belong to round N+1 at save time, so
+    # equality with the sequential run proves the captured-handle plumbing
+    seq = Experiment(Params.from_dict(dict(CFG, pipeline_rounds=False)),
+                     save_results=False)
+    for ep in (1, 2, 3, 4):
+        seq.run_round(ep)
+        for a, b in zip(jax.tree_util.tree_leaves(snaps[ep].params),
+                        jax.tree_util.tree_leaves(seq.global_vars.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the full-state sidecar landed too — for model_last AND every snapshot
+    # (resuming from .epoch_N must not silently reset the defense state)
+    aux = ckpt.load_aux_state(tmp_path / "model_last.pt.tar")
+    assert aux is not None and aux["epoch"] == 4
+    for ep in (1, 2, 3, 4):
+        aux_n = ckpt.load_aux_state(tmp_path / f"model_last.pt.tar.epoch_{ep}")
+        assert aux_n is not None and aux_n["epoch"] == ep
+
+
+def test_best_val_checkpoint_works_pipelined(tmp_path):
+    e = Experiment(Params.from_dict(CFG), save_results=False)
+    e.folder = tmp_path
+    e.run(4)
+    assert (tmp_path / "model_last.pt.tar.best").exists()
+    assert np.isfinite(e.best_loss)
