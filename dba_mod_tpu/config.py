@@ -107,7 +107,10 @@ _DEFAULTS: Dict[str, Any] = {
                                    # auto = on for unsharded TPU runs
     "fused_interpret": False,      # run the fused kernels in pallas
                                    # interpret mode (CPU testing)
-
+    "grouped_clients": False,      # grouped-layout client execution
+                                   # (models/grouped.py); measured
+                                   # perf-neutral vs the vmapped path —
+                                   # TRAIN_FLOOR.md round-5 section
 }
 
 

@@ -1,0 +1,185 @@
+"""Grouped-layout client training step: all C clients in one program, no
+outer vmap.
+
+Drop-in replacement for `jax.vmap(make_client_step(...))` (fl/rounds.py) for
+the ResNet workloads: same inputs/outputs (stacked [C, ...] trees), same
+per-client math — the forward/backward runs through the persistent grouped
+layout (models/grouped.py) instead of vmap's per-conv re-grouping, and the
+SGD/momentum/FoolsGold state is carried in conv layout across the whole scan
+so the grouped-kernel merge stays a free reshape every step. Layout
+conversions happen once per segment, not once per conv per step.
+
+Semantics mirror fl/client.py line for line (reference image_train.py:21-315);
+tests/test_grouped_clients.py asserts equality against the vmapped path.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.models import ModelDef, ModelVars
+from dba_mod_tpu.models.grouped import (client_axis_of, conv_layout_in,
+                                        conv_layout_out, grouped_train_apply)
+from dba_mod_tpu.fl.client import ClientMetrics, SegmentResult
+from dba_mod_tpu.fl.device_data import DeviceData
+from dba_mod_tpu.fl.state import ClientTask, RoundHyper
+
+
+def _bc(v, leaf):
+    """Broadcast a per-client [C] vector against a conv-layout leaf."""
+    ca = client_axis_of(leaf)
+    shape = [1] * leaf.ndim
+    shape[ca] = v.shape[0]
+    return v.reshape(shape)
+
+
+def _tree_sq_per_client(tree) -> jax.Array:
+    """Σ leaf² reduced to [C] (client axis per conv-layout leaf)."""
+    def per_leaf(l):
+        ca = client_axis_of(l)
+        axes = tuple(a for a in range(l.ndim) if a != ca)
+        return jnp.sum(jnp.square(l), axis=axes)
+    return sum(jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(per_leaf, tree)))
+
+
+def _dist_norm_per_client(params, anchor) -> jax.Array:
+    """Per-client ‖w - w_anchor‖₂ with the zero-gradient-safe double-where
+    (ops/losses.py::tree_dist_norm, elementwise per client)."""
+    sq = _tree_sq_per_client(jax.tree_util.tree_map(
+        lambda a, b: a - b, params, anchor))
+    safe = jnp.where(sq > 0.0, sq, 1.0)
+    return jnp.where(sq > 0.0, jnp.sqrt(safe), 0.0)
+
+
+def make_grouped_client_step(model_def: ModelDef, data: DeviceData,
+                             hyper: RoundHyper, fg_enabled: bool):
+    """Returns grouped_step(start_vars, benign_mom, tasks, idx, mask, rngs)
+    -> SegmentResult, with every argument/result stacked [C, ...] — the same
+    contract as jax.vmap(client_step)."""
+    wd, momentum = hyper.weight_decay, hyper.momentum
+
+    def sgd_update(lr_c, keep_c, params, grads, mom):
+        def upd(w, g, m):
+            lr, keep = _bc(lr_c, w), _bc(keep_c, w)
+            g2 = g + wd * w
+            m2 = momentum * m + g2
+            return (jnp.where(keep, w - lr * m2, w),
+                    jnp.where(keep, m2, m))
+        pairs = jax.tree_util.tree_map(upd, params, grads, mom)
+        is_pair = lambda t: isinstance(t, tuple)
+        w2 = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+        m2 = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+        return w2, m2
+
+    def sel_c(keep_c, new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(_bc(keep_c, a), a, b), new, old)
+
+    def grouped_step(start_vars: ModelVars, benign_mom: Any,
+                     task: ClientTask, idx, mask, rngs) -> SegmentResult:
+        C, E, S, B = idx.shape
+        # conv layout in — once per segment (fl/client.py's vmap pays the
+        # equivalent moves once per conv per step)
+        params0 = conv_layout_in(start_vars.params)
+        bn0 = start_vars.batch_stats
+        is_poison_seg = task.poisoning_per_batch > 0          # [C]
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params0)
+        mom0 = sel_c(is_poison_seg, zeros,
+                     conv_layout_in(benign_mom))
+        fg0 = zeros
+        zeros_ce = jnp.zeros((C, E), jnp.float32)
+        metrics0 = ClientMetrics(zeros_ce, zeros_ce, zeros_ce, zeros_ce)
+
+        def step(carry, inp):
+            params, bn, mom, fg, m = carry
+            step_i, bidx, bmask = inp                          # [C,B] each
+            e = step_i // S
+            x, y = jax.vmap(data.fetch_train)(task.slot, bidx)
+            x, y, sel = jax.vmap(data.stamp)(x, y, task.adv_index,
+                                             task.poisoning_per_batch)
+
+            def loss_fn(p):
+                logits, new_bn = grouped_train_apply(model_def, p, bn, x)
+                # per-client masked-mean CE (ops/losses.py::cross_entropy)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, y[:, :, None].astype(jnp.int32), axis=-1)[..., 0]
+                mk = bmask.astype(nll.dtype)
+                denom = jnp.maximum(jnp.sum(mk, axis=1), 1.0)
+                ce_c = jnp.sum(nll * mk, axis=1) / denom       # [C]
+                if hyper.alpha_loss == 1.0:
+                    loss_c = ce_c
+                else:
+                    dist_c = _dist_norm_per_client(p, params0)
+                    loss_c = (task.alpha * ce_c
+                              + (1.0 - task.alpha) * dist_c)
+                # Σ over clients: per-client grads are independent, so the
+                # grad of the sum IS each client's own grad
+                return jnp.sum(loss_c), (loss_c, logits, new_bn)
+
+            (_, (loss_c, logits, new_bn)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            lr_c = task.lr_row[:, e]                           # [C]
+            valid = jnp.sum(bmask, axis=1) > 0                 # [C]
+            params, mom = sgd_update(lr_c, valid, params, grads, mom)
+            if fg_enabled:
+                fg = sel_c(valid, jax.tree_util.tree_map(jnp.add, fg, grads),
+                           fg)
+            bn = sel_c(valid, new_bn, bn)
+
+            preds = jnp.argmax(logits, axis=-1)                # [C,B]
+            bmaskf = bmask.astype(jnp.float32)
+            vf = valid.astype(jnp.float32)                     # [C]
+            m = ClientMetrics(
+                loss_sum=m.loss_sum.at[:, e].add(vf * loss_c),
+                correct=m.correct.at[:, e].add(
+                    vf * jnp.sum((preds == y) * bmaskf, axis=1)),
+                count=m.count.at[:, e].add(vf * jnp.sum(bmaskf, axis=1)),
+                poison_count=m.poison_count.at[:, e].add(
+                    vf * jnp.sum(sel * bmaskf, axis=1)))
+            if hyper.track_batches:
+                ys = (vf * loss_c,
+                      vf * _dist_norm_per_client(params, params0))
+            else:
+                ys = None
+            return (params, bn, mom, fg, m), ys
+
+        xs = (jnp.arange(E * S),
+              jnp.moveaxis(idx.reshape(C, E * S, B), 1, 0),
+              jnp.moveaxis(mask.reshape(C, E * S, B), 1, 0))
+        carry, ys = jax.lax.scan(step, (params0, bn0, mom0, fg0, metrics0),
+                                 xs)
+        params, bn, mom, fg, metrics = carry
+        if hyper.track_batches:
+            batch_loss, batch_dist = (jnp.moveaxis(ys[0], 0, 1),
+                                      jnp.moveaxis(ys[1], 0, 1))
+        else:
+            batch_loss = batch_dist = jnp.zeros((C, 0), jnp.float32)
+
+        # conv layout out — once per segment; everything below matches
+        # fl/client.py's epilogue on stacked [C, ...] trees
+        params = conv_layout_out(params)
+        mom = conv_layout_out(mom)
+        fg = conv_layout_out(fg)
+        start_p = start_vars.params
+        benign_mom_out = _select_tree_c(is_poison_seg, benign_mom, mom)
+        scale = task.scale
+        end_vars = ModelVars(
+            params=jax.tree_util.tree_map(
+                lambda a, w: a + _bcl(scale, w) * (w - a), start_p, params),
+            batch_stats=jax.tree_util.tree_map(
+                lambda a, w: a + _bcl(scale, w) * (w - a), bn0, bn))
+        return SegmentResult(end_vars, benign_mom_out, fg, metrics,
+                             batch_loss, batch_dist)
+
+    def _bcl(v, leaf):  # [C] against a client-leading stacked leaf
+        return v.reshape((v.shape[0],) + (1,) * (leaf.ndim - 1))
+
+    def _select_tree_c(pred_c, new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(_bcl(pred_c, a), a, b), new, old)
+
+    return grouped_step

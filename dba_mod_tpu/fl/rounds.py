@@ -121,6 +121,27 @@ class RoundEngine:
         client_step = make_client_step(
             model_def, data, hyper, fg_enabled, fused_pallas=fused_pallas,
             fused_interpret=bool(params.get("fused_interpret", False)))
+        # grouped-layout client execution (models/grouped.py): holds the
+        # grouped layout vmap's conv batching re-derives per conv. Measured
+        # A/B on the bench chip (benchmarks/grouped_ab.py, TRAIN_FLOOR.md
+        # round-5 section): train phase 0.539 → 0.528 s — within tunnel
+        # noise, because the layout moves live inside XLA's grouped-conv
+        # lowering, not in the vmap program. Kept flag-gated (default OFF:
+        # no measured win, and a second lowering to keep numerically
+        # audited); requires a BasicBlock ResNet and an unsharded clients
+        # axis (GSPMD shards the stacked axis; grouped layout folds it into
+        # features).
+        from dba_mod_tpu.models.grouped import supports_grouped
+        self.use_grouped = bool(params.get("grouped_clients", False))
+        if self.use_grouped and not (supports_grouped(model_def)
+                                     and mesh is None):
+            raise ValueError(
+                "grouped_clients=true requires a BasicBlock-ResNet "
+                "model and an unsharded clients axis")
+        if self.use_grouped:
+            from dba_mod_tpu.fl.grouped_client import make_grouped_client_step
+            grouped_step = make_grouped_client_step(model_def, data, hyper,
+                                                    fg_enabled)
         eval_clean = make_eval_fn(model_def, data, poison=False)
         eval_poison = make_eval_fn(model_def, data, poison=True)
         is_poison_run = bool(params["is_poison"])
@@ -145,8 +166,13 @@ class RoundEngine:
                 rngs = jax.vmap(
                     lambda i: jax.random.fold_in(seg_rng, i))(lane)
                 tasks_s = jax.tree_util.tree_map(lambda l: l[s], tasks_seq)
-                res = jax.vmap(client_step)(start, benign_mom, tasks_s,
-                                            idx_seq[s], mask_seq[s], rngs)
+                if self.use_grouped:
+                    res = grouped_step(start, benign_mom, tasks_s,
+                                       idx_seq[s], mask_seq[s], rngs)
+                else:
+                    res = jax.vmap(client_step)(start, benign_mom, tasks_s,
+                                                idx_seq[s], mask_seq[s],
+                                                rngs)
                 start = res.end_vars
                 benign_mom = res.benign_mom
                 if fg_enabled:

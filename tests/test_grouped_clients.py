@@ -1,0 +1,124 @@
+"""Grouped-layout client path (models/grouped.py, fl/grouped_client.py)
+equals the vmapped path.
+
+Both paths lower the stacked per-client convs to the same grouped
+convolutions; the grouped path removes vmap's per-conv layout moves
+(TRAIN_FLOOR.md). Per-client math is identical, so agreement bars:
+
+- one forward pass: tight (≤5e-5 — last-ulp conv summation only);
+- a full round's deltas: chaos envelope (ReLU gate flips amplify last-ulp
+  conv differences across ~80 SGD steps — the same measured behavior as the
+  cross-framework A/B, PARITY_AB.md), with accuracies equal exactly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.experiment import Experiment
+from dba_mod_tpu.models import build_model
+from dba_mod_tpu.models.grouped import (conv_layout_in, grouped_train_apply,
+                                        supports_grouped)
+
+CIFAR_CFG = dict(
+    type="cifar", lr=0.1, batch_size=8, epochs=2, no_models=4,
+    number_of_total_participants=8, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, internal_poison_epochs=2, is_poison=True,
+    synthetic_data=True, synthetic_train_size=128, synthetic_test_size=64,
+    momentum=0.9, decay=0.0005, sampling_dirichlet=False, local_eval=True,
+    poison_label_swap=2, poisoning_per_batch=4, poison_lr=0.05,
+    scale_weights_poison=2.0, adversary_list=[0], trigger_num=1,
+    alpha_loss=1.0, random_seed=1,
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2]],
+       "0_poison_epochs": [1, 2]})
+
+
+def _max_leaf_diff(a, b):
+    return max(float(np.abs(np.asarray(x) - np.asarray(y)).max())
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+@pytest.mark.parametrize("mtype", ["cifar", "tiny-imagenet-200"])
+def test_grouped_forward_matches_vmapped(mtype):
+    """grouped_train_apply == vmap(model.apply) on one train-mode batch —
+    logits and new BN stats (both stems, incl. the 7×7/maxpool one)."""
+    cfg = dict(CIFAR_CFG, type=mtype)
+    if mtype != "cifar":
+        cfg.update(synthetic_train_size=64, synthetic_test_size=32)
+    p = Params.from_dict(cfg)
+    md = build_model(p)
+    assert supports_grouped(md)
+    C, B = 3, 4
+    keys = jax.random.split(jax.random.key(0), C)
+    mvs = [md.init_vars(k) for k in keys]
+    stack = lambda *ls: jnp.stack(ls)
+    params = jax.tree_util.tree_map(stack, *[m.params for m in mvs])
+    bn = jax.tree_util.tree_map(stack, *[m.batch_stats for m in mvs])
+    hw = md.input_shape[0]
+    x = jax.random.uniform(jax.random.key(1), (C, B, hw, hw, 3))
+
+    from dba_mod_tpu.models import ModelVars
+    logits_v, bn_v = jax.vmap(
+        lambda pp, bb, xx: md.apply(ModelVars(pp, bb), xx, train=True))(
+            params, bn, x)
+    logits_g, bn_g = jax.jit(
+        lambda pp, bb, xx: grouped_train_apply(md, conv_layout_in(pp), bb,
+                                               xx))(params, bn, x)
+    # last-ulp conv-summation differences only; the wider tiny net doubles
+    # the envelope (same ×2 scaling as the torch A/B, PARITY_AB.md)
+    assert _max_leaf_diff(logits_v, logits_g) <= 5e-5
+    assert _max_leaf_diff(bn_v, bn_g) <= 5e-5
+
+
+def _round_pair(cfg):
+    ev = Experiment(Params.from_dict(dict(cfg, grouped_clients=False)),
+                    save_results=False)
+    eg = Experiment(Params.from_dict(dict(cfg, grouped_clients=True)),
+                    save_results=False)
+    assert eg.engine.use_grouped and not ev.engine.use_grouped
+    return ev, eg
+
+
+def test_grouped_round_matches_vmapped_cifar():
+    ev, eg = _round_pair(CIFAR_CFG)
+    rv, rg = ev.run_round(1), eg.run_round(1)
+    # accuracies are discrete — chaos-envelope differences must not move them
+    assert rv["global_acc"] == rg["global_acc"]
+    assert rv["backdoor_acc"] == rg["backdoor_acc"]
+    assert _max_leaf_diff(ev.global_vars.params, eg.global_vars.params) < 5e-4
+    assert _max_leaf_diff(ev.global_vars.batch_stats,
+                          eg.global_vars.batch_stats) < 1e-4
+
+
+def test_grouped_round_foolsgold_blended_loss():
+    """FoolsGold grads accumulation + the α<1 distance-loss branch through
+    the grouped path: wv rows and the similarity feature agree."""
+    cfg = dict(CIFAR_CFG, aggregation_methods="foolsgold", alpha_loss=0.9)
+    ev, eg = _round_pair(cfg)
+    rv, rg = ev.run_round(1), eg.run_round(1)
+    assert rv["global_acc"] == rg["global_acc"]
+    wv_v = ev.recorder.weight_result[1]
+    wv_g = eg.recorder.weight_result[1]
+    # FoolsGold's logit reweighting amplifies the round's chaos envelope
+    # (cosine similarities of grads accumulated over ~32 chaotic SGD steps);
+    # observed ~3e-3 — a real mapping bug shows as O(1) disagreement
+    np.testing.assert_allclose(wv_v, wv_g, atol=2e-2)
+    assert _max_leaf_diff(ev.fg_state.memory, eg.fg_state.memory) < 2e-2
+
+
+def test_grouped_gating():
+    """Default OFF (measured perf-neutral — TRAIN_FLOOR.md round-5 section);
+    explicit grouped_clients=true on an unsupported config is loud."""
+    e = Experiment(Params.from_dict(dict(CIFAR_CFG)), save_results=False)
+    assert not e.engine.use_grouped
+    with pytest.raises(ValueError, match="grouped_clients"):
+        Experiment(Params.from_dict(dict(
+            CIFAR_CFG, type="mnist", synthetic_train_size=64,
+            grouped_clients=True)), save_results=False)
+    with pytest.raises(ValueError, match="grouped_clients"):
+        Experiment(Params.from_dict(dict(CIFAR_CFG, no_models=8,
+                                         num_devices=8,
+                                         grouped_clients=True)),
+                   save_results=False)
