@@ -455,7 +455,8 @@ class TorchFL:
         return _adv_of(self.raw, name, epoch)
 
     def run_round(self, seg_epochs: List[int], agent_names: List,
-                  idx_seq: np.ndarray, mask_seq: np.ndarray
+                  idx_seq: np.ndarray, mask_seq: np.ndarray,
+                  num_samples: List[int] | None = None
                   ) -> List[Dict[str, np.ndarray]]:
         """One reference round over recorded plans idx/mask [I, C, E, S, B] —
         one segment per global epoch in the aggregation interval
@@ -557,9 +558,13 @@ class TorchFL:
             self._foolsgold_update(fg_client_grads, agent_names)
         elif raw.get("aggregation_methods", "mean") == "geom_median":
             # RFA: alphas are the per-client dataset sizes the clients
-            # reported (= partition sizes; see README quirk table row)
-            num_samples = [int(mask_seq[0, c, 0].sum())
-                           for c in range(len(agent_names))]
+            # reported (= partition sizes; see README quirk table row).
+            # Callers with unequal partitions (Dirichlet trajectories) pass
+            # the plan's true sizes; the first-step-batch fallback is only
+            # proportional for equal splits.
+            if num_samples is None:
+                num_samples = [int(mask_seq[0, c, 0].sum())
+                               for c in range(len(agent_names))]
             self._rfa_update(deltas, num_samples)
         else:
             _fedavg_apply(raw, self.global_sd, deltas)
@@ -860,16 +865,40 @@ def _compare_states(train_deltas, torch_deltas, agent_names, to_torch,
     return per_client, g_diff
 
 
+def build_round_plans(exp, params, agent_names, seg_epochs):
+    """Shared-stimuli plan builder: the SAME batch plans drive both
+    frameworks (consumes the experiment's plan RNG once). Returns
+    (tasks_list, idx [I,C,E,S,B], mask, num_samples [C])."""
+    from dba_mod_tpu.data import build_batch_plan
+    from dba_mod_tpu.fl.state import build_client_tasks
+
+    slots = np.array([exp.client_slots[n] for n in agent_names], np.int64)
+    tasks_list, idx_list, mask_list = [], [], []
+    num_samples = None
+    for ep in seg_epochs:
+        tasks_s = build_client_tasks(params, agent_names, ep, slots,
+                                     exp.epochs_max, None)
+        plan = build_batch_plan(
+            [exp.client_indices[n] for n in agent_names],
+            [int(e) for e in tasks_s.num_epochs],
+            int(params["batch_size"]), exp.plan_rng,
+            min_steps=exp.steps_per_epoch, min_epochs=exp.epochs_max)
+        if num_samples is None:
+            num_samples = plan.num_samples.astype(np.float32)
+        tasks_list.append(tasks_s)
+        idx_list.append(plan.idx)
+        mask_list.append(plan.mask)
+    return tasks_list, np.stack(idx_list), np.stack(mask_list), num_samples
+
+
 def run_ab(overrides: dict, n_rounds: int) -> dict:
     """Run n_rounds through both frameworks; return the comparison report."""
     import jax
     import jax.numpy as jnp
 
     from dba_mod_tpu.config import Params
-    from dba_mod_tpu.data import build_batch_plan
     from dba_mod_tpu.fl.experiment import Experiment
     from dba_mod_tpu.fl.selection import select_agents
-    from dba_mod_tpu.fl.state import build_client_tasks
     from dba_mod_tpu.ops.triggers import build_pixel_pattern_bank
 
     params = Params.from_dict(overrides)
@@ -890,25 +919,10 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
         epoch = 1 + rnum * interval
         agent_names, _ = select_agents(params, epoch, exp.participants,
                                        exp.benign_names, exp.select_rng)
-        slots = np.array([exp.client_slots[n] for n in agent_names], np.int64)
         seg_epochs = list(range(epoch, epoch + interval))
-        tasks_list, idx_list, mask_list = [], [], []
-        num_samples = None
-        for ep in seg_epochs:
-            tasks_s = build_client_tasks(params, agent_names, ep, slots,
-                                         exp.epochs_max, None)
-            plan = build_batch_plan(
-                [exp.client_indices[n] for n in agent_names],
-                [int(e) for e in tasks_s.num_epochs],
-                int(params["batch_size"]), exp.plan_rng,
-                min_steps=exp.steps_per_epoch, min_epochs=exp.epochs_max)
-            if num_samples is None:
-                num_samples = plan.num_samples.astype(np.float32)
-            tasks_list.append(tasks_s)
-            idx_list.append(plan.idx)
-            mask_list.append(plan.mask)
+        tasks_list, idx_np, mask_np, num_samples = build_round_plans(
+            exp, params, agent_names, seg_epochs)
         C = len(agent_names)
-        idx_np, mask_np = np.stack(idx_list), np.stack(mask_list)
         tasks_seq = jax.tree_util.tree_map(
             lambda *ls: jnp.asarray(np.stack(ls)), *tasks_list)
         lane = jnp.arange(C, dtype=jnp.int32)
@@ -1295,9 +1309,19 @@ def main():
               f"Adaptive poison LR per round: {lrs} (base "
               f"{LOAN_AB['poison_lr']}; a decayed value means the "
               f"backdoor-accuracy rule fired, loan_train.py:71-75).\n\n")
+    content = out.getvalue()
+    # preserve the trajectory section (written by benchmarks/trajectory_ab)
+    from benchmarks.trajectory_ab import (BEGIN_MARK, END_MARK,
+                                          extract_trajectory_section)
+    try:
+        sec = extract_trajectory_section(open("PARITY_AB.md").read())
+        if sec is not None:
+            content += BEGIN_MARK + sec + END_MARK + "\n"
+    except FileNotFoundError:
+        pass
     with open("PARITY_AB.md", "w") as f:
-        f.write(out.getvalue())
-    print(out.getvalue())
+        f.write(content)
+    print(content)
 
 
 if __name__ == "__main__":

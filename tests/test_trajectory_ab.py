@@ -1,0 +1,86 @@
+"""Compressed converged-regime trajectory A/B (VERDICT r4 ask #1) — the
+slow-marked envelope assertion; the full curves artifact is
+`python -m benchmarks.trajectory_ab` (PARITY_AB.md trajectory section).
+
+Both frameworks resume from the SAME pretrained state and replay the
+reference's single-shot DBA schedule structure (staggered poison rounds,
+then clean rounds of backdoor decay) with shared batch plans. The ±1%
+north-star envelope (BASELINE.json) is asserted on the curve level: mean
+per-round gap and final-state gaps.
+"""
+import numpy as np
+import pytest
+
+from benchmarks.trajectory_ab import (multi_shot_epochs, pretrain,
+                                      run_trajectory, single_shot_epochs,
+                                      splice_trajectory_section,
+                                      extract_trajectory_section, summarize,
+                                      CIFAR_TRAJ, MNIST_TRAJ)
+
+# compressed CIFAR lane: same hyper-structure as the full harness
+# (model-replacement strength eta*scale/no_models = 1 preserved via
+# scale=no_models/eta), smaller population/data so the test compiles+runs
+# in minutes instead of hours
+CIFAR_SMALL = dict(
+    CIFAR_TRAJ, number_of_total_participants=16, no_models=6,
+    scale_weights_poison=60,  # 6 clients / eta 0.1 → full replacement
+    synthetic_train_size=1200, synthetic_test_size=400, batch_size=32,
+    internal_poison_epochs=3, adversary_list=[5, 3, 7, 11])
+
+MNIST_SMALL = dict(
+    MNIST_TRAJ, number_of_total_participants=16, no_models=6,
+    synthetic_train_size=1200, synthetic_test_size=400,
+    internal_poison_epochs=4, poisoning_per_batch=10,
+    adversary_list=[5, 3, 7, 11])
+
+
+@pytest.mark.slow
+def test_cifar_single_shot_converged_envelope():
+    E0 = 12
+    init_vars, accs = pretrain(CIFAR_SMALL, E0)
+    # "converged": stable non-trivial accuracy on the learnable fabricated
+    # data — far from the 10% chance level of the r4 near-init cells
+    assert accs[-1] > 40.0, f"pretrain did not converge: {accs}"
+
+    cfg = dict(CIFAR_SMALL, **single_shot_epochs(E0))
+    traj = run_trajectory(cfg, init_vars, E0 + 1, E0 + 21,
+                          label="test: cifar single-shot + fedavg")
+    s = summarize(traj)
+    # the attack landed on both sides (model replacement from a converged
+    # state — the reference's headline phenomenon)
+    assert s["jax_peak_backdoor"] > 50.0 and s["torch_peak_backdoor"] > 50.0
+    # ±1% envelope at the curve level (both frameworks integrate their own
+    # f32 rounding; per-round decay transients can wobble, the running
+    # claim is mean + final agreement)
+    assert s["mean_clean_gap"] <= 1.0, s
+    assert s["mean_backdoor_gap"] <= 1.5, s
+    assert s["final_clean_gap"] <= 1.0, s
+    assert s["final_backdoor_gap"] <= 1.0, s
+
+
+@pytest.mark.slow
+def test_mnist_multi_shot_ramp_envelope():
+    M0 = 6
+    init_vars, accs = pretrain(MNIST_SMALL, M0)
+    cfg = dict(MNIST_SMALL, **multi_shot_epochs(M0 + 1, M0 + 8))
+    traj = run_trajectory(cfg, init_vars, M0 + 1, M0 + 11,
+                          label="test: mnist multi-shot ramp")
+    s = summarize(traj)
+    assert s["jax_peak_backdoor"] > 50.0 and s["torch_peak_backdoor"] > 50.0
+    assert s["mean_clean_gap"] <= 1.0, s
+    assert s["mean_backdoor_gap"] <= 1.5, s
+    assert s["final_clean_gap"] <= 1.0, s
+    assert s["final_backdoor_gap"] <= 1.0, s
+
+
+def test_trajectory_section_splice(tmp_path):
+    """Marker-section splice/extract round-trips and preserves surrounding
+    content (parity_ab.main regeneration path)."""
+    md = tmp_path / "P.md"
+    md.write_text("# head\nbody\n")
+    splice_trajectory_section(str(md), "SECTION ONE\n")
+    assert extract_trajectory_section(md.read_text()) == "\nSECTION ONE\n"
+    splice_trajectory_section(str(md), "SECTION TWO\n")
+    text = md.read_text()
+    assert extract_trajectory_section(text) == "\nSECTION TWO\n"
+    assert text.startswith("# head\nbody\n") and "SECTION ONE" not in text
