@@ -93,6 +93,9 @@ _DEFAULTS: Dict[str, Any] = {
     "synthetic_data": False,       # force the synthetic dataset backend
     "synthetic_train_size": 0,     # 0 = backend default
     "synthetic_test_size": 0,      # 0 = backend default
+    "synthetic_noise_std": 25.0,   # task difficulty: 25 saturates (smoke
+                                   # runs); ~90 plateaus below 100% like
+                                   # real data (datasets.py docstring)
     "num_devices": 0,              # 0 = use all visible devices on the clients mesh
     "run_dir": "./runs",
     "checkpoint_dir": "saved_models",  # root for resume/pretrain checkpoints

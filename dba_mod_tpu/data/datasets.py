@@ -157,9 +157,18 @@ _IMAGE_SHAPES = {cfg.TYPE_MNIST: (28, 28, 1, 10),
 
 
 def synthetic_image_dataset(dtype: str, train_size: int = 0,
-                            test_size: int = 0, seed: int = 0) -> ImageData:
+                            test_size: int = 0, seed: int = 0,
+                            noise_std: float = 25.0) -> ImageData:
     """Deterministic learnable stand-in: per-class low-frequency template +
-    noise, labels balanced. Sized like the real dataset unless overridden."""
+    noise, labels balanced. Sized like the real dataset unless overridden.
+
+    `noise_std` (config key `synthetic_noise_std`) sets the task's
+    difficulty: 25 → models saturate at ~100% (handy for fast smoke runs);
+    ~90 → a ResNet plateaus below saturation with nonzero loss, emulating
+    the real-data converged regime (nonzero gradients at the plateau — the
+    regime the reference resumes its attacks from; fully-saturated models
+    make FoolsGold's gradient similarities rounding noise and turn
+    post-attack recovery into a cliff)."""
     h, w, c, ncls = _IMAGE_SHAPES[dtype]
     defaults = {cfg.TYPE_MNIST: (60000, 10000), cfg.TYPE_CIFAR: (50000, 10000),
                 cfg.TYPE_TINYIMAGENET: (100000, 10000)}
@@ -170,7 +179,7 @@ def synthetic_image_dataset(dtype: str, train_size: int = 0,
 
     def make(n, rng):
         labels = rng.randint(0, ncls, size=n).astype(np.int32)
-        noise = rng.randn(n, h, w, c).astype(np.float32) * 25.0
+        noise = rng.randn(n, h, w, c).astype(np.float32) * float(noise_std)
         imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
         return imgs, labels
 
@@ -257,7 +266,8 @@ def load_image_dataset(params: cfg.Params) -> ImageData:
         data = synthetic_image_dataset(
             t, train_size=int(params.get("synthetic_train_size", 0) or 0),
             test_size=int(params.get("synthetic_test_size", 0) or 0),
-            seed=int(params.get("random_seed", 1)))
+            seed=int(params.get("random_seed", 1)),
+            noise_std=float(params.get("synthetic_noise_std", 25.0)))
     return data
 
 
