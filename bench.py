@@ -160,9 +160,11 @@ def measure_phases(exp) -> dict:
                                     mask_seq, lane, rng_t)
         if k == 0:
             return train.delta_norms[0]
+        from dba_mod_tpu.fl.rounds import nbt_client_deltas
         res = exp.engine.aggregate_fn(
             exp.global_vars, exp.fg_state, train.deltas, train.fg_grads,
-            train.fg_feature, tasks_last.participant_id, ns, rng_a)
+            train.fg_feature, tasks_last.participant_id, ns, rng_a,
+            nbt_client_deltas(mask_seq, tasks_seq.scale))
         if k == 1:
             return res.wv[0]
         prev = jax.tree_util.tree_map(jnp.zeros_like, train.deltas)

@@ -934,7 +934,10 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
         agg = exp.engine.aggregate_fn(
             exp.global_vars, exp.fg_state, train.deltas, train.fg_grads,
             train.fg_feature, jnp.asarray(tasks_list[0].participant_id),
-            jnp.asarray(num_samples), rng_a)
+            jnp.asarray(num_samples), rng_a,
+            nbt_client_deltas(jnp.asarray(mask_np),
+                              jnp.asarray(np.stack(
+                                  [t.scale for t in tasks_list]))))
         exp.global_vars = agg.new_vars
         exp.fg_state = agg.new_fg_state
         jax_globals = jax.device_get(exp.engine.global_evals_fn(agg.new_vars))
@@ -1045,7 +1048,9 @@ def run_ab_loan(overrides: dict, n_rounds: int) -> dict:
         agg = exp.engine.aggregate_fn(
             exp.global_vars, exp.fg_state, train.deltas, train.fg_grads,
             train.fg_feature, jnp.asarray(tasks.participant_id),
-            jnp.asarray(plan.num_samples.astype(np.float32)), rng_a)
+            jnp.asarray(plan.num_samples.astype(np.float32)), rng_a,
+            nbt_client_deltas(jnp.asarray(plan.mask[None]),
+                              jnp.asarray(tasks.scale[None])))
         exp.global_vars = agg.new_vars
         exp.fg_state = agg.new_fg_state
         jax_globals = jax.device_get(exp.engine.global_evals_fn(agg.new_vars))

@@ -208,7 +208,10 @@ def run_trajectory(overrides: dict, init_vars, start_epoch: int,
         agg = exp.engine.aggregate_fn(
             exp.global_vars, exp.fg_state, train.deltas, train.fg_grads,
             train.fg_feature, jnp.asarray(tasks_list[0].participant_id),
-            jnp.asarray(num_samples), rng_a)
+            jnp.asarray(num_samples), rng_a,
+            nbt_client_deltas(jnp.asarray(mask_np),
+                              jnp.asarray(np.stack(
+                                  [t.scale for t in tasks_list]))))
         exp.global_vars = agg.new_vars
         exp.fg_state = agg.new_fg_state
         g = jax.device_get(exp.engine.global_evals_fn(agg.new_vars))

@@ -529,9 +529,11 @@ class Experiment:
                                        rng_train)
         tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
         tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
+        from dba_mod_tpu.fl.rounds import nbt_client_deltas
         result = self.engine.aggregate_fn(
             self.global_vars, self.fg_state, train.deltas, train.fg_grads,
-            train.fg_feature, tasks_first.participant_id, ns_dev, rng_agg)
+            train.fg_feature, tasks_first.participant_id, ns_dev, rng_agg,
+            nbt_client_deltas(mask_seq, tasks_seq.scale))
 
         # dispatch every eval before any host sync — one blocking transfer,
         # deferred to finalize_round so a caller can overlap the next round

@@ -37,6 +37,39 @@ from dba_mod_tpu.ops import aggregation as agg
 from dba_mod_tpu.ops.losses import tree_global_norm
 
 
+def count_bn_layers(batch_stats: Any) -> int:
+    """Number of BatchNorm layers = number of `mean` running-stat leaves.
+
+    Each BN layer in the reference's state_dict carries one
+    `num_batches_tracked` scalar alongside running_mean/running_var; RFA's
+    Weiszfeld distance sums squared differences over ALL state entries
+    (helper.py:376-381), so the counter term enters the geometry once per BN
+    layer."""
+    paths = jax.tree_util.tree_flatten_with_path(batch_stats)[0]
+    n = 0
+    for path, _leaf in paths:
+        last = path[-1]
+        key = getattr(last, "key", getattr(last, "name", None))
+        if key == "mean":
+            n += 1
+    return n
+
+
+def nbt_client_deltas(mask_seq: jax.Array, scale_seq: jax.Array) -> jax.Array:
+    """Per-client `num_batches_tracked` deltas for one round, [C] f32.
+
+    torch BN increments the counter once per train-mode forward batch, so a
+    client's counter delta is its number of REAL (non-padded) batch steps;
+    the model-replacement epilogue scales the whole state_dict including the
+    counter — `anchor + (v-anchor)·γ` copied into an int64 buffer truncates
+    (image_train.py:166-171) — and with aggr_epoch_interval > 1 each segment
+    re-anchors, so the round delta is Σ_seg trunc(steps_seg · γ_seg).
+
+    mask_seq: [S, C, E, steps, B] validity mask; scale_seq: [S, C]."""
+    steps = jnp.sum(jnp.any(mask_seq, axis=-1), axis=(2, 3))   # [S, C]
+    return jnp.sum(jnp.trunc(steps.astype(jnp.float32) * scale_seq), axis=0)
+
+
 class TrainResult(NamedTuple):
     deltas: ModelVars             # stacked [C, ...]: w_end - w_global
     fg_grads: Any                 # [C, ...] grads accumulated over the round
@@ -199,7 +232,7 @@ class RoundEngine:
         def aggregate_fn(global_vars: ModelVars,
                          fg_state: agg.FoolsGoldState, deltas: ModelVars,
                          fg_grads, fg_feature, participant_ids, num_samples,
-                         rng) -> AggregateResult:
+                         rng, nbt_deltas=None) -> AggregateResult:
             C = fg_feature.shape[0]
             wv = jnp.zeros((C,), jnp.float32)
             alpha = jnp.zeros((C,), jnp.float32)
@@ -216,7 +249,8 @@ class RoundEngine:
                     maxiter=hyper.geom_median_maxiter,
                     max_update_norm=hyper.max_update_norm,
                     dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
-                    rng=rng)
+                    rng=rng, nbt_deltas=nbt_deltas,
+                    n_bn=count_bn_layers(global_vars.batch_stats))
                 new_vars, calls, wv, alpha = (r.new_state, r.num_oracle_calls,
                                               r.wv, r.distances)
                 is_updated = r.is_updated
@@ -256,7 +290,7 @@ class RoundEngine:
                 out_shardings=out_shard)
             self.aggregate_fn = jax.jit(
                 aggregate_fn,
-                in_shardings=(rep, rep, cs, cs, cs, cs, cs, rep))
+                in_shardings=(rep, rep, cs, cs, cs, cs, cs, rep, cs))
         else:
             self.train_fn = jax.jit(train_fn)
             self.aggregate_fn = jax.jit(aggregate_fn)
@@ -431,7 +465,8 @@ class RoundEngine:
             res = aggregate_fn(global_vars, fg_state, train.deltas,
                                train.fg_grads, train.fg_feature,
                                tasks_first.participant_id, num_samples,
-                               rng_a)
+                               rng_a,
+                               nbt_client_deltas(mask_seq, tasks_seq.scale))
             prev = (train.seg_deltas[-1] if num_segments > 1 else
                     jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
             locals_ = (local_evals(global_vars, train.deltas, tasks_last,

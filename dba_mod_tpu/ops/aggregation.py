@@ -87,6 +87,8 @@ class RfaResult(NamedTuple):
     is_updated: jax.Array         # bool (norm rejection)
     wv: jax.Array                 # [C] final Weiszfeld weights
     distances: jax.Array          # [C] ‖median - Δ_c‖ (reference's out-alphas)
+    nbt_median: jax.Array         # f32 scalar — the (truncated-int-valued)
+                                  # `num_batches_tracked` entry of the median
 
 
 def geometric_median_update(global_state: Any, stacked_deltas: Any,
@@ -95,49 +97,78 @@ def geometric_median_update(global_state: Any, stacked_deltas: Any,
                             ftol: float = 1e-6,
                             max_update_norm: float | None = None,
                             dp_sigma: float = 0.0,
-                            rng: jax.Array | None = None) -> RfaResult:
+                            rng: jax.Array | None = None,
+                            nbt_deltas: jax.Array | None = None,
+                            n_bn: int = 0) -> RfaResult:
     """Weiszfeld geometric median of client deltas (helper.py:295-373).
 
     Runs the full `maxiter` iterations with a `done` mask emulating the
     reference's ftol break — identical numerics, static XLA control flow.
+
+    `nbt_deltas` [C] / `n_bn`: the per-client `num_batches_tracked` deltas
+    and the number of BN layers. The reference's client updates are full
+    state_dicts, so the int64 batch counters participate in every Weiszfeld
+    quantity (l2dist / objective / update-norm, helper.py:376-392) — with
+    Dirichlet partitions the per-client counter deltas differ (≈ local step
+    counts, ×γ for model-replacement clients), which measurably shifts the
+    weights on BN models. The median's counter entry is truncated PER CLIENT
+    contribution (weighted_average_oracle's `temp.type_as(data)` int cast,
+    helper.py:410-415). The counter's effect on the APPLIED update is nil in
+    every runnable reference config: on torch ≥1.5 `data.add_(float)` into
+    int64 raises, and on the paper-era torch ≤1.4 the `median * eta` scalar
+    multiply truncates eta<1 to 0 — the global counter is frozen either way,
+    so this function folds the counter into the geometry only and reports
+    `nbt_median` for the record.
     """
     points = flatten_stacked(stacked_deltas)                    # [C, P]
     alphas = num_samples.astype(jnp.float32)
     alphas = alphas / jnp.sum(alphas)
+    nbt = (jnp.asarray(nbt_deltas, jnp.float32) if nbt_deltas is not None
+           else jnp.zeros((points.shape[0],), jnp.float32))
+    nbf = float(n_bn) if nbt_deltas is not None else 0.0
 
     def wavg(w):
-        return (w / jnp.sum(w)) @ points                        # [P]
+        wn = w / jnp.sum(w)
+        # per-client truncation of the counter contribution = the
+        # reference's per-point `type_as(int64)` cast before accumulation
+        return wn @ points, jnp.sum(jnp.trunc(wn * nbt))        # [P], scalar
 
-    def objective(m):
-        return jnp.sum(alphas * jnp.linalg.norm(points - m[None, :], axis=1))
+    def dists(m, mn):
+        sq = jnp.sum(jnp.square(points - m[None, :]), axis=1)
+        return jnp.sqrt(sq + nbf * jnp.square(nbt - mn))
 
-    median0 = wavg(alphas)
-    obj0 = objective(median0)
+    def objective(m, mn):
+        return jnp.sum(alphas * dists(m, mn))
+
+    median0, nbt0 = wavg(alphas)
+    obj0 = objective(median0, nbt0)
 
     def body(carry, _):
-        median, obj, wv, done, calls = carry
-        dist = jnp.linalg.norm(points - median[None, :], axis=1)
+        median, nbt_med, obj, wv, done, calls = carry
+        dist = dists(median, nbt_med)
         weights = alphas / jnp.maximum(eps, dist)
         weights = weights / jnp.sum(weights)
-        new_median = wavg(weights)
-        new_obj = objective(new_median)
+        new_median, new_nbt = wavg(weights)
+        new_obj = objective(new_median, new_nbt)
         converged = jnp.abs(obj - new_obj) < ftol * new_obj
         step_done = done | converged
         # The reference records wv only on non-breaking iterations
         # (helper.py:352) and crashes when none happened; we instead always
         # keep the latest weights (the documented wv=None fix, SURVEY §7.2.8).
         median = jnp.where(done, median, new_median)
+        nbt_med = jnp.where(done, nbt_med, new_nbt)
         obj = jnp.where(done, obj, new_obj)
         wv = jnp.where(done, wv, weights)
         calls = calls + jnp.where(done, 0, 1)
-        return (median, obj, wv, step_done, calls), None
+        return (median, nbt_med, obj, wv, step_done, calls), None
 
-    init = (median0, obj0, alphas, jnp.asarray(False), jnp.int32(1))
-    (median, _obj, wv, _done, calls), _ = jax.lax.scan(
+    init = (median0, nbt0, obj0, alphas, jnp.asarray(False), jnp.int32(1))
+    (median, nbt_med, _obj, wv, _done, calls), _ = jax.lax.scan(
         body, init, None, length=maxiter)
 
-    distances = jnp.linalg.norm(points - median[None, :], axis=1)
-    update_norm = jnp.linalg.norm(median)
+    distances = dists(median, nbt_med)
+    update_norm = jnp.sqrt(jnp.sum(jnp.square(median))
+                           + nbf * jnp.square(nbt_med))
     is_updated = (jnp.asarray(True) if max_update_norm is None
                   else update_norm < max_update_norm)
 
@@ -150,7 +181,7 @@ def geometric_median_update(global_state: Any, stacked_deltas: Any,
     new_state = jax.tree_util.tree_map(
         lambda g, u: jnp.where(is_updated, g + u.astype(g.dtype), g),
         global_state, median_tree)
-    return RfaResult(new_state, calls, is_updated, wv, distances)
+    return RfaResult(new_state, calls, is_updated, wv, distances, nbt_med)
 
 
 # ----------------------------------------------------------------- FoolsGold
