@@ -898,6 +898,7 @@ def run_ab(overrides: dict, n_rounds: int) -> dict:
 
     from dba_mod_tpu.config import Params
     from dba_mod_tpu.fl.experiment import Experiment
+    from dba_mod_tpu.fl.rounds import nbt_client_deltas
     from dba_mod_tpu.fl.selection import select_agents
     from dba_mod_tpu.ops.triggers import build_pixel_pattern_bank
 
@@ -995,6 +996,7 @@ def run_ab_loan(overrides: dict, n_rounds: int) -> dict:
     from dba_mod_tpu.config import Params
     from dba_mod_tpu.data import build_batch_plan
     from dba_mod_tpu.fl.experiment import Experiment
+    from dba_mod_tpu.fl.rounds import nbt_client_deltas
     from dba_mod_tpu.fl.selection import select_agents
     from dba_mod_tpu.fl.state import build_client_tasks
     from dba_mod_tpu.ops.triggers import build_feature_trigger_bank

@@ -171,6 +171,7 @@ def run_trajectory(overrides: dict, init_vars, start_epoch: int,
 
     from dba_mod_tpu.config import Params
     from dba_mod_tpu.fl.experiment import Experiment
+    from dba_mod_tpu.fl.rounds import nbt_client_deltas
     from dba_mod_tpu.fl.selection import select_agents
     from dba_mod_tpu.models import ModelVars
     from dba_mod_tpu.ops.triggers import build_pixel_pattern_bank

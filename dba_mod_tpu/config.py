@@ -114,6 +114,32 @@ _DEFAULTS: Dict[str, Any] = {
                                    # (models/grouped.py); measured
                                    # perf-neutral vs the vmapped path —
                                    # TRAIN_FLOOR.md round-5 section
+    # --- fault model & robustness (fl/faults.py, README "Fault model") ---
+    "fault_injection": False,      # master switch for the deterministic
+                                   # fault harness (fl/faults.py); off =
+                                   # nothing traced, zero cost
+    "fault_seed": 0,               # fault plans are f(fault_seed, epoch) —
+                                   # independent of every other RNG stream
+    "fault_dropout_prob": 0.0,     # P(client never reports this round)
+    "fault_corrupt_prob": 0.0,     # P(payload arrives NaN-corrupted)
+    "fault_blowup_prob": 0.0,      # P(payload scaled by blowup factor)
+    "fault_blowup_factor": 1e8,    # norm-blowup magnitude
+    "fault_stale_prob": 0.0,       # P(client replays last round's delta)
+    "screen_updates": "auto",      # server-side delta validation/quarantine
+                                   # (finite + norm screen): "auto" = on iff
+                                   # fault_injection; true/false to force
+    "screen_norm_mult": 0.0,       # quarantine ‖Δ‖ > mult × survivor-median
+                                   # norm; 0 disables the norm screen (the
+                                   # finite screen always runs when
+                                   # screening is on); retries escalate this
+    "max_round_retries": 2,        # re-runs of a round whose aggregated
+                                   # model goes non-finite (escalated
+                                   # screening each attempt)
+    "retry_backoff_s": 0.0,        # host backoff before retry k:
+                                   # min(retry_backoff_s · 2^(k-1), 30 s)
+    "min_surviving_clients": 1,    # fewer survivors → skip aggregation,
+                                   # carry the global model, mark the round
+                                   # degraded
 }
 
 
@@ -144,6 +170,14 @@ class Params:
                 f"unknown aggregation_methods: {merged['aggregation_methods']!r}")
         if merged["type"] not in IMAGE_TYPES + (TYPE_LOAN,):
             raise ValueError(f"unknown workload type: {merged['type']!r}")
+        if merged["screen_updates"] not in ("auto", True, False):
+            raise ValueError(
+                f"screen_updates must be 'auto'/true/false, got "
+                f"{merged['screen_updates']!r}")
+        if int(merged["max_round_retries"]) < 0:
+            raise ValueError("max_round_retries must be >= 0")
+        if int(merged["min_surviving_clients"]) < 1:
+            raise ValueError("min_surviving_clients must be >= 1")
         return cls(raw=merged)
 
     # ------------------------------------------------------------- dict access

@@ -8,4 +8,5 @@ axis, and aggregation consumes the stacked deltas directly — the host only
 schedules, selects agents and records metrics.
 """
 from dba_mod_tpu.fl.state import ClientTask, RoundHyper
+from dba_mod_tpu.fl.faults import FaultConfig, FaultPlan
 from dba_mod_tpu.fl.experiment import Experiment

@@ -38,10 +38,17 @@ from dba_mod_tpu.utils.recorder import Recorder
 logger = logging.getLogger("dba_mod_tpu")
 
 
-def _pad_tasks(tasks, pad: int, epochs_max: int):
+def _pad_tasks(tasks, pad: int, aggregation: str):
     """Append `pad` inert clients (fully-masked plans → zero deltas) so the
-    stacked axis tiles the mesh. Sound only for FedAvg (static no_models
-    divisor); the caller enforces that."""
+    stacked axis tiles the mesh. Sound only for FedAvg, whose divisor is the
+    static no_models — a zero delta shifts RFA's geometric median and
+    FoolsGold's similarity geometry. Enforced here, not by caller
+    convention."""
+    if aggregation != cfg.AGGR_MEAN:
+        raise ValueError(
+            f"inert-client padding is only sound for FedAvg (aggregation="
+            f"{cfg.AGGR_MEAN!r}); got {aggregation!r} — pick a no_models "
+            "that tiles the mesh instead")
     from dba_mod_tpu.fl.state import ClientTask
     return ClientTask(
         slot=np.pad(tasks.slot, (0, pad)),
@@ -70,6 +77,12 @@ class RoundInFlight:
     tasks_list: List[Any]
     mask_list: List[Any]
     payload: Any                 # device trees handed to jax.device_get
+    # fault-tolerance outcome of the dispatch (fl/faults.py + the screening
+    # pass in fl/rounds.py): retries consumed re-running the round after a
+    # non-finite aggregate, and whether the host forced a degraded round
+    # (restored the pre-round state) because retries ran out
+    n_retries: int = 0
+    forced_degraded: bool = False
     # Post-round state handles + host RNG snapshots, captured at dispatch
     # time: under pipelining, by the time round N finalizes the experiment's
     # live attributes already belong to round N+1, so checkpoints must save
@@ -163,6 +176,27 @@ class Experiment:
         self.engine = RoundEngine(params, self.model_def, self.device_data,
                                   self.eval_plans, mesh=self.mesh,
                                   num_segments=self.interval)
+        # fault-tolerance layer (fl/faults.py; README "Fault model"): the
+        # robust round program screens payloads into a survivor mask and the
+        # host retries/degrades rounds below. Sequential-debug runs the
+        # split train/aggregate path which bypasses the fault layer — refuse
+        # the combination rather than silently not injecting.
+        if self.engine.robust and self.sequential_debug:
+            raise ValueError("fault_injection/screen_updates are not "
+                             "supported with sequential_debug")
+        if (self.engine.fault_cfg.stale_enabled
+                and jax.process_count() > 1):
+            raise ValueError("fault_stale_prob > 0 is single-controller "
+                             "only (the replayed-delta carry cannot be "
+                             "placed across processes)")
+        self.max_round_retries = int(params.get("max_round_retries", 2))
+        self.retry_backoff_s = float(params.get("retry_backoff_s", 0.0))
+        self._fault_key = jax.random.key(self.engine.fault_cfg.seed)
+        # last round's submitted deltas (the stale lane's replay source).
+        # Deliberately NOT in the resume sidecar (it is model-sized × C):
+        # a resumed run's first stale replay falls back to zeros — fault
+        # PLANS still reproduce exactly (pure f(fault_seed, epoch))
+        self._prev_deltas = None
         grad_len = int(np.prod(
             self.model_def.similarity_param(self.global_vars.params).shape))
         self.fg_state = foolsgold_init(self.num_participants, grad_len)
@@ -342,13 +376,14 @@ class Experiment:
             from dba_mod_tpu.parallel.mesh import pad_clients
             c_pad = pad_clients(C, self.mesh)
             if c_pad != C:
-                tasks = _pad_tasks(tasks, c_pad - C, self.epochs_max)
+                tasks = _pad_tasks(tasks, c_pad - C, self.params.aggregation)
                 C = c_pad
         I = self.interval  # real rounds stack one segment per interval epoch
         tasks_stacked = jax.tree_util.tree_map(
             lambda l: jnp.asarray(np.stack([l] * I)), tasks)
         lane = jnp.arange(C, dtype=jnp.int32)
         rng_t, rng_a = jax.random.split(jax.random.key(0))
+        robust_args = self._robust_round_args(1, C)
         for s in buckets:
             idx = jnp.zeros((I, C, E, s, B), jnp.int32)
             mask = jnp.zeros((I, C, E, s, B), bool)
@@ -365,7 +400,7 @@ class Experiment:
                     # warm the fused round program — the one real rounds run
                     self.engine.round_fn(self.global_vars, self.fg_state,
                                          tasks_seq, idx, mask, lane, ns,
-                                         rng_t, rng_a)
+                                         rng_t, rng_a, *robust_args)
                     self._warmed_buckets.add(s)
                     break
                 except Exception as exc:  # noqa: BLE001 — the TPU
@@ -490,7 +525,7 @@ class Experiment:
                         "multiple (inert-client padding is only sound for "
                         "FedAvg, whose divisor is the static no_models)")
                 pad = c_pad - len(agent_names)
-                tasks_list = [_pad_tasks(t, pad, self.epochs_max)
+                tasks_list = [_pad_tasks(t, pad, params.aggregation)
                               for t in tasks_list]
                 idx_list = [np.pad(i, ((0, pad),) + ((0, 0),) * 3)
                             for i in idx_list]
@@ -512,6 +547,11 @@ class Experiment:
         rng_train, rng_agg = jax.random.split(round_key)
         lane = jnp.arange(idx_seq.shape[1], dtype=jnp.int32)
         if not self.sequential_debug:
+            if self.engine.robust:
+                return self._dispatch_robust(
+                    epoch, t0, seg_epochs, agent_names, adv_names,
+                    tasks_list, mask_list, tasks_seq, idx_seq, mask_seq,
+                    lane, ns_dev, rng_train, rng_agg)
             # one program, one dispatch: train → aggregate → evals
             new_vars, new_fg, payload = self.engine.round_fn(
                 self.global_vars, self.fg_state, tasks_seq, idx_seq,
@@ -555,13 +595,113 @@ class Experiment:
         batch_dev = (train.batch_loss, train.batch_dist) if track else None
         payload = (locals_dev, globals_dev, train.metrics, train.delta_norms,
                    result.wv, result.alpha, batch_dev, result.is_updated,
-                   seg_locals_dev)
+                   seg_locals_dev, None)
         return RoundInFlight(epoch=epoch, t0=t0, seg_epochs=seg_epochs,
                              agent_names=agent_names, adv_names=adv_names,
                              tasks_list=tasks_list, mask_list=mask_list,
                              payload=payload, vars_after=self.global_vars,
                              fg_after=self.fg_state,
                              rng_after=self._snapshot_rng())
+
+    def _zero_deltas(self, n_clients: int):
+        """A [C]-stacked all-zero delta tree — the stale lane's replay
+        source before any round has been submitted."""
+        tree = jax.tree_util.tree_map(
+            lambda l: jnp.zeros((n_clients,) + l.shape, l.dtype),
+            self.global_vars)
+        if self.mesh is not None:
+            from dba_mod_tpu.parallel.mesh import client_sharding
+            tree = jax.device_put(tree, client_sharding(self.mesh))
+        return tree
+
+    def _robust_round_args(self, epoch: int, n_clients: int,
+                           norm_mult: Optional[float] = None,
+                           use_carry: bool = False):
+        """The extra (rng_f, prev_deltas, norm_mult) inputs of the robust
+        round program; () when the fault layer is off. The fault key is a
+        pure function of (fault_seed, epoch) — independent of every other
+        RNG stream, so fault schedules reproduce across runs and retries."""
+        if not self.engine.robust:
+            return ()
+        rng_f = jax.random.fold_in(self._fault_key, epoch)
+        if self.engine.fault_cfg.stale_enabled:
+            prev = (self._prev_deltas
+                    if use_carry and self._prev_deltas is not None
+                    else self._zero_deltas(n_clients))
+        else:
+            prev = ()
+        nm = self.engine.base_norm_mult if norm_mult is None else norm_mult
+        return (rng_f, prev, jnp.float32(nm))
+
+    @staticmethod
+    def _escalate_norm_mult(cur: float) -> float:
+        """Retry-k screening escalation: switch the norm screen on if it was
+        off (10× the survivor median catches any blowup that slipped a
+        finite-only screen), then halve it each further retry, floored at
+        1× the median — tighter than that would quarantine the majority."""
+        return 10.0 if cur <= 0 else max(cur / 2.0, 1.0)
+
+    def _dispatch_robust(self, epoch, t0, seg_epochs, agent_names,
+                         adv_names, tasks_list, mask_list, tasks_seq,
+                         idx_seq, mask_seq, lane, ns_dev, rng_train,
+                         rng_agg) -> RoundInFlight:
+        """The robust round dispatch: run the fused round program, then —
+        only when screening is on — check the post-aggregation model is
+        finite (ONE host sync; this is what pipeline depth costs under the
+        fault layer) and re-run the round from the captured pre-round state
+        with escalated screening up to max_round_retries. If retries run
+        out, force a degraded round: restore the pre-round state, re-run
+        the global battery on it, and record the degradation."""
+        vars_before, fg_before = self.global_vars, self.fg_state
+        C = int(idx_seq.shape[1])
+        norm_mult: Optional[float] = None
+        retries = 0
+        while True:
+            extra = self._robust_round_args(epoch, C, norm_mult=norm_mult,
+                                            use_carry=True)
+            new_vars, new_fg, payload, deltas_out = self.engine.round_fn(
+                vars_before, fg_before, tasks_seq, idx_seq, mask_seq, lane,
+                ns_dev, rng_train, rng_agg, *extra)
+            if not self.engine.screening:
+                finite = True  # unscreened injection: faults flow through
+                break
+            finite = bool(payload[9].global_finite)  # the one host sync
+            if finite or retries >= self.max_round_retries:
+                break
+            retries += 1
+            cur = (self.engine.base_norm_mult if norm_mult is None
+                   else norm_mult)
+            norm_mult = self._escalate_norm_mult(cur)
+            if self.retry_backoff_s > 0:
+                time.sleep(min(self.retry_backoff_s * 2 ** (retries - 1),
+                               30.0))
+            logger.warning(
+                "epoch %d: aggregated model non-finite; retry %d/%d with "
+                "norm screen at %.2f× median", epoch, retries,
+                self.max_round_retries, norm_mult)
+        forced = self.engine.screening and not finite
+        if forced:
+            # retries exhausted and the aggregate is still non-finite:
+            # degrade — carry the pre-round model/defense state forward and
+            # re-run the global battery on it so the record stays finite
+            logger.warning(
+                "epoch %d: aggregated model non-finite after %d retries; "
+                "degraded round (global model carried forward)", epoch,
+                retries)
+            new_vars, new_fg = vars_before, fg_before
+            globals_dev = self.engine.global_evals_fn(new_vars)
+            payload = payload[:1] + (globals_dev,) + payload[2:]
+        self.global_vars = new_vars
+        self.fg_state = new_fg
+        if self.engine.fault_cfg.stale_enabled:
+            self._prev_deltas = deltas_out
+        return RoundInFlight(
+            epoch=epoch, t0=t0, seg_epochs=seg_epochs,
+            agent_names=agent_names, adv_names=adv_names,
+            tasks_list=tasks_list, mask_list=mask_list, payload=payload,
+            n_retries=retries, forced_degraded=forced,
+            vars_after=new_vars, fg_after=new_fg,
+            rng_after=self._snapshot_rng())
 
     def _snapshot_rng(self) -> Dict[str, Any]:
         """Host snapshot of every RNG stream a round consumes, taken right
@@ -573,19 +713,31 @@ class Experiment:
 
     def finalize_round(self, fl: RoundInFlight) -> Dict[str, Any]:
         (locals_, globals_, metrics, delta_norms, wv, alpha,
-         batches, is_updated, seg_locals) = jax.device_get(fl.payload)
+         batches, is_updated, seg_locals, rstats) = jax.device_get(
+             fl.payload)
         self.last_is_updated = bool(is_updated)
         self.last_global_loss = float(globals_.clean.loss)
         if self.is_poison_run:
             self.last_backdoor_acc = float(globals_.poison.acc)
+        # robust counters: from the jitted screen plus the host retry path
+        # (a forced degradation restored the pre-round state host-side)
+        robust = {"n_quarantined": 0, "n_dropped": 0,
+                  "n_retries": int(fl.n_retries),
+                  "degraded": bool(fl.forced_degraded)}
+        if rstats is not None:
+            robust["n_quarantined"] = int(rstats.n_quarantined)
+            robust["n_dropped"] = int(rstats.n_dropped)
+            robust["degraded"] = (bool(rstats.degraded)
+                                  or bool(fl.forced_degraded))
         self._record(fl.epoch, fl.seg_epochs, fl.agent_names, fl.adv_names,
                      fl.tasks_list, metrics, locals_, globals_, delta_norms,
-                     wv, alpha, fl.t0, batches, fl.mask_list, seg_locals)
+                     wv, alpha, fl.t0, batches, fl.mask_list, seg_locals,
+                     robust)
         return {"epoch": fl.epoch, "agents": fl.agent_names,
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
                                  if self.is_poison_run else None),
-                "round_time": time.time() - fl.t0}
+                "round_time": time.time() - fl.t0, **robust}
 
     def _train_sequential(self, tasks_seq, idx_seq, mask_seq, rng):
         """Sequential debug mode (SURVEY §7.2.4): run clients one at a time
@@ -620,7 +772,7 @@ class Experiment:
     # ------------------------------------------------------------- recording
     def _record(self, epoch, seg_epochs, agent_names, adv_names, tasks_list,
                 metrics, locals_, globals_, delta_norms, wv, alpha, t0,
-                batches=None, mask_list=None, seg_locals=None):
+                batches=None, mask_list=None, seg_locals=None, robust=None):
         # metrics leaves are [I, C, E]; tasks_list one ClientTask per segment.
         # Local clean evals: final segment from locals_, intermediate
         # segments (interval > 1) from seg_locals — matching the reference's
@@ -796,7 +948,8 @@ class Experiment:
             global_loss=float(globals_.clean.loss),
             backdoor_acc=(float(globals_.poison.acc)
                           if self.is_poison_run else None),
-            round_time=time.time() - t0)
+            round_time=time.time() - t0,
+            **(robust or {}))
         rec.save(self.is_poison_run)
 
     # ------------------------------------------------------------------- run

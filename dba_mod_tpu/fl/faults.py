@@ -1,0 +1,130 @@
+"""Deterministic fault injection for the round path.
+
+The reference simulator (and the seed port) assumes every selected client
+returns a finite, well-formed delta. Real federated deployments — and the
+robust-aggregation literature this framework exists to study — are defined by
+partial participation and byzantine payloads. This module perturbs per-round
+client *outcomes* (what the server receives), never the training computation
+itself: faults model the uplink, not the local SGD.
+
+Fault taxonomy (per client, per round; mutually exclusive, resolved in
+priority order dropout > corrupt > blowup > stale):
+
+  dropout — the client never reports. Its payload is zeroed and it is
+            excluded from the survivor mask (the server always knows who
+            reported, independent of any screening).
+  corrupt — the payload arrives NaN/Inf-poisoned (bit flips, truncated
+            uploads). Caught by the server's finite screen.
+  blowup  — the payload is scaled by ``fault_blowup_factor`` (fp overflow,
+            exploding local training). Caught by the norm screen when
+            enabled; otherwise it may push the aggregated model non-finite,
+            which the round-level retry path handles.
+  stale   — the client replays the delta it *submitted* the previous round
+            (straggler whose round-N upload arrives at round N+1). Finite
+            and norm-plausible, hence deliberately NOT screenable. Applies
+            to deltas only: FoolsGold aggregates gradient accumulators, so
+            under FoolsGold a stale client is a no-op by construction.
+
+The plan is a pure function of ``(fault_seed, epoch)`` via ``jax.random`` —
+a fault schedule reproduces exactly across runs and resumes, and is
+independent of every other RNG stream (selection, plans, training). One
+resume caveat: the stale lane's replay source (last round's submitted
+deltas) is not checkpointed, so the first post-resume stale replay falls
+back to a zero delta; the plan itself is unaffected. All injection runs
+inside the jitted round program; with ``fault_injection: false`` none of
+it is traced, so the fault path costs nothing when disabled.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.ops.aggregation import _bc_mask as _bc
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Static (compile-time) fault-injection knobs."""
+    enabled: bool
+    dropout_prob: float
+    corrupt_prob: float
+    blowup_prob: float
+    blowup_factor: float
+    stale_prob: float
+    seed: int
+
+    @property
+    def stale_enabled(self) -> bool:
+        return self.enabled and self.stale_prob > 0.0
+
+    @classmethod
+    def from_params(cls, p: cfg.Params) -> "FaultConfig":
+        probs = {k: float(p.get(f"fault_{k}_prob", 0.0))
+                 for k in ("dropout", "corrupt", "blowup", "stale")}
+        for k, v in probs.items():
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"fault_{k}_prob={v} not in [0, 1]")
+        return cls(enabled=bool(p.get("fault_injection", False)),
+                   dropout_prob=probs["dropout"],
+                   corrupt_prob=probs["corrupt"],
+                   blowup_prob=probs["blowup"],
+                   blowup_factor=float(p.get("fault_blowup_factor", 1e8)),
+                   stale_prob=probs["stale"],
+                   seed=int(p.get("fault_seed", 0)))
+
+
+class FaultPlan(NamedTuple):
+    """Per-client fault assignment for one round (all [C] bool)."""
+    dropped: jax.Array
+    corrupt: jax.Array
+    blowup: jax.Array
+    stale: jax.Array
+
+
+def make_fault_plan(fcfg: FaultConfig, rng: jax.Array,
+                    counted: jax.Array) -> FaultPlan:
+    """Draw one round's fault assignment. ``counted`` ([C] bool) marks real
+    clients — inert mesh-padding lanes never fault (their zero deltas must
+    stay zero or padding would perturb FedAvg's static divisor)."""
+    kd, kc, kb, ks = jax.random.split(rng, 4)
+
+    def draw(k, p, free):
+        hit = (jax.random.uniform(k, counted.shape) < p) & free
+        return hit, free & ~hit
+
+    free = counted
+    dropped, free = draw(kd, fcfg.dropout_prob, free)
+    corrupt, free = draw(kc, fcfg.corrupt_prob, free)
+    blowup, free = draw(kb, fcfg.blowup_prob, free)
+    stale, _ = draw(ks, fcfg.stale_prob, free)
+    return FaultPlan(dropped, corrupt, blowup, stale)
+
+
+def perturb_tree(tree: Any, plan: FaultPlan, fcfg: FaultConfig,
+                 stale_tree: Optional[Any] = None) -> Any:
+    """Apply one round's faults to a client-stacked payload pytree.
+
+    Non-float leaves pass through untouched (NaN has no integer encoding;
+    the survivor mask, not the payload, is what excludes a dropped client's
+    integer state). When ``stale_tree`` is None the stale lane is a no-op.
+    """
+    def f(leaf, stale_leaf):
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            return leaf
+        x = jnp.where(_bc(plan.corrupt, leaf), jnp.nan, leaf)
+        x = jnp.where(_bc(plan.blowup, leaf),
+                      leaf * jnp.asarray(fcfg.blowup_factor, leaf.dtype), x)
+        if stale_leaf is not None:
+            x = jnp.where(_bc(plan.stale, leaf),
+                          stale_leaf.astype(leaf.dtype), x)
+        x = jnp.where(_bc(plan.dropped, leaf),
+                      jnp.zeros((), leaf.dtype), x)
+        return x
+
+    if stale_tree is None:
+        return jax.tree_util.tree_map(lambda l: f(l, None), tree)
+    return jax.tree_util.tree_map(f, tree, stale_tree)

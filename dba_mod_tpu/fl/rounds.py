@@ -29,6 +29,7 @@ import numpy as np
 
 from dba_mod_tpu import config as cfg
 from dba_mod_tpu.models import ModelDef, ModelVars
+from dba_mod_tpu.fl import faults as flt
 from dba_mod_tpu.fl.client import ClientMetrics, make_client_step
 from dba_mod_tpu.fl.device_data import DeviceData
 from dba_mod_tpu.fl.evaluation import EvalResult, make_eval_fn
@@ -89,6 +90,54 @@ class TrainResult(NamedTuple):
                                   # global-epoch loop); empty list when I == 1
 
 
+class RobustStats(NamedTuple):
+    """Per-round fault-tolerance outcome, computed inside the jitted round
+    program (None in the payload when the fault layer is off)."""
+    n_dropped: jax.Array      # i32 — injected dropouts (never reported)
+    n_quarantined: jax.Array  # i32 — reported but failed the screen
+    n_surviving: jax.Array    # i32 — survivors among counted clients
+    degraded: jax.Array       # bool — aggregation skipped (< min survivors)
+    global_finite: jax.Array  # bool — post-aggregation model is all-finite
+    survivor_mask: jax.Array  # [C] bool
+
+
+def _per_client_finite(tree: Any) -> jax.Array:
+    """[C] bool — every leaf entry of each client's stacked row is finite."""
+    flags = None
+    for l in jax.tree_util.tree_leaves(tree):
+        f = jnp.all(jnp.isfinite(l.astype(jnp.float32))
+                    .reshape(l.shape[0], -1), axis=1)
+        flags = f if flags is None else flags & f
+    return flags
+
+
+def screen_client_updates(deltas: ModelVars, reported: jax.Array,
+                          counted: jax.Array, norm_mult: jax.Array,
+                          extra_trees=()):
+    """The server-side delta validation/quarantine pass (jit-traced).
+
+    Two screens over the stacked client payloads:
+      finite — every entry of the delta (and any `extra_trees`, e.g. the
+               FoolsGold gradient accumulators) must be finite;
+      norm   — ‖Δ_params‖ must not exceed `norm_mult` × the median norm of
+               the reported-and-finite counted clients. `norm_mult` is a
+               TRACED scalar so round-level retries can escalate it without
+               recompiling; <= 0 disables the norm screen (threshold = ∞).
+
+    Returns (survivor_mask [C] bool, norms [C]). A client that never
+    reported (`reported` False) is excluded regardless of screens; inert
+    padding lanes (`counted` False) never enter the median.
+    """
+    finite = _per_client_finite(deltas)
+    for t in extra_trees:
+        finite = finite & _per_client_finite(t)
+    norms = jax.vmap(lambda d: tree_global_norm(d.params))(deltas)
+    valid = reported & finite & counted
+    med = jnp.nanmedian(jnp.where(valid, norms, jnp.nan))
+    thresh = jnp.where(norm_mult > 0, norm_mult * med, jnp.inf)
+    return reported & finite & (norms <= thresh), norms
+
+
 class AggregateResult(NamedTuple):
     new_vars: ModelVars
     new_fg_state: agg.FoolsGoldState
@@ -145,6 +194,17 @@ class RoundEngine:
         self.num_segments = num_segments
         hyper = self.hyper
         fg_enabled = hyper.aggregation == cfg.AGGR_FOOLSGOLD
+        # fault layer (fl/faults.py + the screening/quarantine pass below):
+        # every flag is static, so with fault_injection off and screening
+        # off the robust path is simply not traced
+        self.fault_cfg = fcfg = flt.FaultConfig.from_params(params)
+        screen = params.get("screen_updates", "auto")
+        self.screening = fcfg.enabled if screen == "auto" else bool(screen)
+        self.robust = fcfg.enabled or self.screening
+        self.min_surviving = max(1, int(params.get("min_surviving_clients",
+                                                   1)))
+        self.base_norm_mult = float(params.get("screen_norm_mult", 0.0))
+        screening, min_surv = self.screening, self.min_surviving
         # fused per-step updates: pallas multi-tensor kernels; sound only
         # when the clients axis is unsharded (GSPMD cannot partition a
         # custom call), so the mesh path keeps the per-leaf jnp form
@@ -232,7 +292,9 @@ class RoundEngine:
         def aggregate_fn(global_vars: ModelVars,
                          fg_state: agg.FoolsGoldState, deltas: ModelVars,
                          fg_grads, fg_feature, participant_ids, num_samples,
-                         rng, nbt_deltas=None) -> AggregateResult:
+                         rng, nbt_deltas=None, mask=None) -> AggregateResult:
+            # mask ([C], optional): survivor mask from the quarantine pass —
+            # routes to the mask-aware rule variants; None is the dense path
             C = fg_feature.shape[0]
             wv = jnp.zeros((C,), jnp.float32)
             alpha = jnp.zeros((C,), jnp.float32)
@@ -240,9 +302,15 @@ class RoundEngine:
             is_updated = jnp.asarray(True)
             new_fg = fg_state
             if hyper.aggregation == cfg.AGGR_MEAN:
-                new_vars = agg.fedavg_update(
-                    global_vars, deltas, hyper.eta, hyper.no_models,
-                    hyper.sigma if hyper.diff_privacy else 0.0, rng)
+                if mask is None:
+                    new_vars = agg.fedavg_update(
+                        global_vars, deltas, hyper.eta, hyper.no_models,
+                        hyper.sigma if hyper.diff_privacy else 0.0, rng)
+                else:
+                    new_vars = agg.fedavg_update_masked(
+                        global_vars, deltas, hyper.eta, hyper.no_models,
+                        mask, num_samples > 0,
+                        hyper.sigma if hyper.diff_privacy else 0.0, rng)
             elif hyper.aggregation == cfg.AGGR_GEO_MED:
                 r = agg.geometric_median_update(
                     global_vars, deltas, num_samples, hyper.eta,
@@ -250,7 +318,8 @@ class RoundEngine:
                     max_update_norm=hyper.max_update_norm,
                     dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
                     rng=rng, nbt_deltas=nbt_deltas,
-                    n_bn=count_bn_layers(global_vars.batch_stats))
+                    n_bn=count_bn_layers(global_vars.batch_stats),
+                    mask=mask)
                 new_vars, calls, wv, alpha = (r.new_state, r.num_oracle_calls,
                                               r.wv, r.distances)
                 is_updated = r.is_updated
@@ -259,7 +328,7 @@ class RoundEngine:
                     global_vars.params, fg_grads, fg_feature,
                     participant_ids, fg_state, hyper.eta, hyper.lr,
                     hyper.momentum, hyper.weight_decay,
-                    use_memory=hyper.fg_use_memory)
+                    use_memory=hyper.fg_use_memory, mask=mask)
                 # BN stats are not aggregated by FoolsGold (the reference
                 # steps an optimizer over named_parameters only,
                 # helper.py:286-290)
@@ -449,26 +518,90 @@ class RoundEngine:
 
         self.backdoor_acc_fn = jax.jit(backdoor_acc)
 
-        # The whole round as ONE program: train → aggregate → local evals →
-        # global evals. One dispatch, no cross-program buffer boundaries
-        # (the separate fns above stay for sequential_debug and for bench
-        # phase diagnostics). Returns (new_vars, new_fg_state, payload) with
-        # payload ordered exactly as Experiment.finalize_round unpacks it.
+        # The whole round as ONE program: train → [inject faults → screen] →
+        # aggregate → local evals → global evals. One dispatch, no
+        # cross-program buffer boundaries (the separate fns above stay for
+        # sequential_debug and for bench phase diagnostics). Returns
+        # (new_vars, new_fg_state, payload) — payload ordered exactly as
+        # Experiment.finalize_round unpacks it, with a RobustStats (or None)
+        # in the last slot. The robust variant additionally takes
+        # (rng_f, prev_deltas, norm_mult) and returns the submitted deltas
+        # as a 4th output so the next round can replay them for the stale
+        # fault lane (an empty tuple when staleness is off).
         do_local_eval = bool(params.get("local_eval", True))
 
-        def round_fn(global_vars: ModelVars, fg_state, tasks_seq, idx_seq,
-                     mask_seq, lane, num_samples, rng_t, rng_a):
+        def _round(global_vars: ModelVars, fg_state, tasks_seq, idx_seq,
+                   mask_seq, lane, num_samples, rng_t, rng_a,
+                   rng_f=None, prev_deltas=(), norm_mult=None):
+            robust = norm_mult is not None  # trace-time switch
             train = train_fn(global_vars, tasks_seq, idx_seq, mask_seq,
                              lane, rng_t)
+            deltas, fg_grads = train.deltas, train.fg_grads
+            fg_feature = train.fg_feature
             tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
             tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
-            res = aggregate_fn(global_vars, fg_state, train.deltas,
-                               train.fg_grads, train.fg_feature,
-                               tasks_first.participant_id, num_samples,
-                               rng_a,
-                               nbt_client_deltas(mask_seq, tasks_seq.scale))
+            nbt = nbt_client_deltas(mask_seq, tasks_seq.scale)
+            stats = None
+            deltas_out = ()
+            if robust:
+                counted = num_samples > 0
+                reported = jnp.ones_like(counted)
+                n_dropped = jnp.int32(0)
+                if fcfg.enabled:
+                    plan = flt.make_fault_plan(fcfg, rng_f, counted)
+                    stale = prev_deltas if fcfg.stale_enabled else None
+                    deltas = flt.perturb_tree(deltas, plan, fcfg, stale)
+                    if fg_enabled:
+                        # FoolsGold aggregates the gradient accumulators,
+                        # not the deltas — corrupt that payload too (stale
+                        # replay stays delta-only; see faults.py docstring)
+                        fg_grads = flt.perturb_tree(fg_grads, plan, fcfg)
+                        fg_feature = flt.perturb_tree(fg_feature, plan,
+                                                      fcfg)
+                    reported = ~plan.dropped
+                    n_dropped = jnp.sum(
+                        plan.dropped & counted).astype(jnp.int32)
+                if fcfg.stale_enabled:
+                    deltas_out = deltas  # what the server RECEIVED
+                if screening:
+                    extra = (fg_grads,) if fg_enabled else ()
+                    smask, _norms = screen_client_updates(
+                        deltas, reported, counted, norm_mult, extra)
+                else:
+                    # dropout is server-visible without any screening: a
+                    # client that never reported cannot be aggregated
+                    smask = reported
+                n_quar = jnp.sum(reported & ~smask
+                                 & counted).astype(jnp.int32)
+                n_surv = jnp.sum(smask & counted).astype(jnp.int32)
+                degraded = n_surv < min_surv
+                res = aggregate_fn(global_vars, fg_state, deltas, fg_grads,
+                                   fg_feature, tasks_first.participant_id,
+                                   num_samples, rng_a, nbt,
+                                   mask=smask.astype(jnp.float32))
+                # graceful degradation: too few survivors → skip the
+                # aggregate, carry the global model and defense state
+                new_vars = jax.tree_util.tree_map(
+                    lambda g, a: jnp.where(degraded, g, a),
+                    global_vars, res.new_vars)
+                new_fg = jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(degraded, o, n),
+                    fg_state, res.new_fg_state)
+                gfin = jnp.asarray(True)
+                for l in jax.tree_util.tree_leaves(new_vars):
+                    gfin = gfin & jnp.all(
+                        jnp.isfinite(l.astype(jnp.float32)))
+                stats = RobustStats(n_dropped, n_quar, n_surv, degraded,
+                                    gfin, smask)
+                res = res._replace(new_vars=new_vars, new_fg_state=new_fg)
+            else:
+                res = aggregate_fn(global_vars, fg_state, deltas, fg_grads,
+                                   fg_feature, tasks_first.participant_id,
+                                   num_samples, rng_a, nbt)
             prev = (train.seg_deltas[-1] if num_segments > 1 else
                     jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
+            # the local battery evaluates what each client TRAINED (faults
+            # model the uplink, not local training) — pre-fault deltas
             locals_ = (local_evals(global_vars, train.deltas, tasks_last,
                                    prev)
                        if do_local_eval else None)
@@ -478,9 +611,24 @@ class RoundEngine:
             globals_ = global_evals(res.new_vars)
             track_pair = ((train.batch_loss, train.batch_dist)
                           if hyper.track_batches else None)
-            return (res.new_vars, res.new_fg_state,
-                    (locals_, globals_, train.metrics, train.delta_norms,
-                     res.wv, res.alpha, track_pair, res.is_updated, seg_l))
+            payload = (locals_, globals_, train.metrics, train.delta_norms,
+                       res.wv, res.alpha, track_pair, res.is_updated, seg_l,
+                       stats)
+            if robust:
+                return res.new_vars, res.new_fg_state, payload, deltas_out
+            return res.new_vars, res.new_fg_state, payload
+
+        def round_fn(global_vars: ModelVars, fg_state, tasks_seq, idx_seq,
+                     mask_seq, lane, num_samples, rng_t, rng_a):
+            return _round(global_vars, fg_state, tasks_seq, idx_seq,
+                          mask_seq, lane, num_samples, rng_t, rng_a)
+
+        def round_fn_robust(global_vars: ModelVars, fg_state, tasks_seq,
+                            idx_seq, mask_seq, lane, num_samples, rng_t,
+                            rng_a, rng_f, prev_deltas, norm_mult):
+            return _round(global_vars, fg_state, tasks_seq, idx_seq,
+                          mask_seq, lane, num_samples, rng_t, rng_a,
+                          rng_f, prev_deltas, norm_mult)
 
         if mesh is not None:
             from dba_mod_tpu.parallel.mesh import (client_sharding,
@@ -493,10 +641,17 @@ class RoundEngine:
             # (it feeds the next round's rep in_shardings), and the small
             # metrics payload is replicated so finalize_round's device_get
             # is host-local on EVERY process of a multi-host run
-            self.round_fn = jax.jit(
-                round_fn,
-                in_shardings=(rep2, rep2, seg_cs2, seg_cs2, seg_cs2, cs2,
-                              cs2, rep2, rep2),
-                out_shardings=(rep2, rep2, rep2))
+            base_in = (rep2, rep2, seg_cs2, seg_cs2, seg_cs2, cs2, cs2,
+                       rep2, rep2)
+            if self.robust:
+                self.round_fn = jax.jit(
+                    round_fn_robust,
+                    in_shardings=base_in + (rep2, cs2, rep2),
+                    out_shardings=(rep2, rep2, rep2, cs2))
+            else:
+                self.round_fn = jax.jit(
+                    round_fn, in_shardings=base_in,
+                    out_shardings=(rep2, rep2, rep2))
         else:
-            self.round_fn = jax.jit(round_fn)
+            self.round_fn = jax.jit(round_fn_robust if self.robust
+                                    else round_fn)

@@ -18,6 +18,15 @@ helper.py:193-231). Three rules, matching reference semantics:
   tensor's accumulated gradient, per-participant historical memory, pardoning,
   logit re-weighting, applied through one torch-SGD step on trainable params
   only.
+
+Every rule additionally accepts a survivor mask ([C] — clients screened out
+by the server's quarantine pass, fl/rounds.py): FedAvg renormalizes over
+survivors, Weiszfeld zeroes the excluded clients' weights, FoolsGold masks
+the excluded similarity rows and memory writes. Excluded payload rows are
+where-zeroed FIRST (`survivor_sanitize`) so NaN/Inf quarantined payloads
+cannot leak through `0 * NaN = NaN` arithmetic. With an all-ones mask every
+masked rule reduces exactly (bitwise for FedAvg, to f32 identity for the
+rest) to the dense rule — tests/test_faults.py pins this.
 """
 from __future__ import annotations
 
@@ -52,6 +61,22 @@ def unflatten_like(vec: jax.Array, tree: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _bc_mask(mask: jax.Array, leaf: jax.Array) -> jax.Array:
+    """[C] mask → [C, 1, ...] broadcast against a client-stacked leaf."""
+    return mask.reshape((mask.shape[0],) + (1,) * (leaf.ndim - 1))
+
+
+def survivor_sanitize(tree: Any, mask: jax.Array) -> Any:
+    """Where-zero the masked-out clients' rows of a stacked payload.
+
+    Quarantined payloads may be NaN/Inf; plain `mask * leaf` would propagate
+    them (0 · NaN = NaN), so exclusion must select, not multiply. With an
+    all-ones mask this returns the input values bitwise unchanged."""
+    return jax.tree_util.tree_map(
+        lambda l: jnp.where(_bc_mask(mask > 0, l), l, jnp.zeros((), l.dtype)),
+        tree)
+
+
 def dp_noise_like(rng: jax.Array, tree: Any, sigma: float) -> Any:
     """Gaussian DP noise per state entry (helper.py:186-191)."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -80,6 +105,36 @@ def fedavg_update(global_state: Any, stacked_deltas: Any, eta: float,
     return new_state
 
 
+def fedavg_update_masked(global_state: Any, stacked_deltas: Any, eta: float,
+                         no_models: int, mask: jax.Array,
+                         counted: jax.Array, dp_sigma: float = 0.0,
+                         rng: jax.Array | None = None) -> Any:
+    """FedAvg renormalized over the survivor mask.
+
+    Dense FedAvg divides by the static `no_models`; here the divisor drops
+    one for every *counted* client the mask excludes (inert mesh-padding
+    lanes — `counted` False — contribute zero deltas and never move the
+    divisor, preserving the reference's static-divisor semantics). The scale
+    is written as `(eta/no_models) · (no_models/divisor)` so an all-ones
+    mask yields the dense rule's exact python-float scale — bitwise
+    equivalence, not just tolerance."""
+    deltas = survivor_sanitize(stacked_deltas, mask)
+    excluded = jnp.sum((counted > 0) & ~(mask > 0))
+    divisor = jnp.maximum(jnp.float32(no_models) - excluded, 1.0)
+    ratio = jnp.float32(no_models) / divisor
+    scale = (eta / no_models) * ratio
+
+    def upd(g, d):
+        return (g + scale * jnp.sum(d, axis=0).astype(g.dtype)).astype(g.dtype)
+
+    new_state = jax.tree_util.tree_map(upd, global_state, deltas)
+    if dp_sigma and rng is not None:
+        noise = dp_noise_like(rng, new_state, dp_sigma)
+        new_state = jax.tree_util.tree_map(lambda s, n: s + n.astype(s.dtype),
+                                           new_state, noise)
+    return new_state
+
+
 # ------------------------------------------------------------- RFA / Weiszfeld
 class RfaResult(NamedTuple):
     new_state: Any
@@ -99,7 +154,8 @@ def geometric_median_update(global_state: Any, stacked_deltas: Any,
                             dp_sigma: float = 0.0,
                             rng: jax.Array | None = None,
                             nbt_deltas: jax.Array | None = None,
-                            n_bn: int = 0) -> RfaResult:
+                            n_bn: int = 0,
+                            mask: jax.Array | None = None) -> RfaResult:
     """Weiszfeld geometric median of client deltas (helper.py:295-373).
 
     Runs the full `maxiter` iterations with a `done` mask emulating the
@@ -119,12 +175,24 @@ def geometric_median_update(global_state: Any, stacked_deltas: Any,
     multiply truncates eta<1 to 0 — the global counter is frozen either way,
     so this function folds the counter into the geometry only and reports
     `nbt_median` for the record.
+
+    `mask` ([C], optional): survivor mask from the quarantine screen.
+    Excluded clients get zero Weiszfeld weight at every iteration (their
+    alphas are zeroed before normalization) and their point rows are
+    where-zeroed so non-finite quarantined payloads cannot poison the
+    distance geometry. mask=None (or all-ones) is the dense rule.
     """
+    if mask is not None:
+        stacked_deltas = survivor_sanitize(stacked_deltas, mask)
     points = flatten_stacked(stacked_deltas)                    # [C, P]
     alphas = num_samples.astype(jnp.float32)
+    if mask is not None:
+        alphas = alphas * mask.astype(jnp.float32)
     alphas = alphas / jnp.sum(alphas)
     nbt = (jnp.asarray(nbt_deltas, jnp.float32) if nbt_deltas is not None
            else jnp.zeros((points.shape[0],), jnp.float32))
+    if mask is not None:
+        nbt = nbt * mask.astype(jnp.float32)
     nbf = float(n_bn) if nbt_deltas is not None else 0.0
 
     def wavg(w):
@@ -196,10 +264,21 @@ def foolsgold_init(num_participants: int, grad_len: int) -> FoolsGoldState:
                                            jnp.float32))
 
 
-def foolsgold_weights(feature_grads: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def foolsgold_weights(feature_grads: jax.Array,
+                      mask: jax.Array | None = None
+                      ) -> Tuple[jax.Array, jax.Array]:
     """The FoolsGold re-weighting (helper.py:574-607) on a [C, L] gradient
-    matrix. Returns (wv [C], alpha [C])."""
+    matrix. Returns (wv [C], alpha [C]).
+
+    `mask` ([C], optional): survivor mask. Excluded rows are where-zeroed
+    before the cosine matrix (a NaN row would poison every similarity) and
+    their wv is zeroed ahead of the max-normalization so a quarantined
+    client can neither receive nor distort aggregation weight. mask=None
+    (or all-ones) is the dense rule."""
     eps = 1e-12
+    if mask is not None:
+        feature_grads = jnp.where(mask[:, None] > 0, feature_grads,
+                                  jnp.zeros((), feature_grads.dtype))
     norms = jnp.linalg.norm(feature_grads, axis=1)
     normed = feature_grads / jnp.maximum(norms, eps)[:, None]
     n = feature_grads.shape[0]
@@ -218,6 +297,11 @@ def foolsgold_weights(feature_grads: jax.Array) -> Tuple[jax.Array, jax.Array]:
     wv = jnp.clip(wv, 0.0, 1.0)
     alpha = row_max
 
+    if mask is not None:
+        # zero excluded rows BEFORE the max-normalization: a zeroed feature
+        # row has no similarity to anyone (wv = 1) and would otherwise both
+        # keep full weight and deflate every survivor's normalized weight
+        wv = wv * mask.astype(wv.dtype)
     wv = wv / jnp.max(wv)
     wv = jnp.where(wv == 1.0, 0.99, wv)
     logit = jnp.log(wv / (1.0 - wv)) + 0.5
@@ -240,7 +324,8 @@ def foolsgold_update(global_params: Any, stacked_grads: Any,
                      feature_grads: jax.Array, participant_ids: jax.Array,
                      fg_state: FoolsGoldState, eta: float, lr: float,
                      momentum: float, weight_decay: float,
-                     use_memory: bool = True) -> FoolsGoldResult:
+                     use_memory: bool = True,
+                     mask: jax.Array | None = None) -> FoolsGoldResult:
     """helper.py:259-293 + FoolsGold.aggregate_gradients (:534-572).
 
     `stacked_grads`: per-client accumulated gradients over trainable params
@@ -249,10 +334,21 @@ def foolsgold_update(global_params: Any, stacked_grads: Any,
     similarity layer (the reference's `client_grads[i][-2]`). Only trainable
     params are updated; BN stats are untouched (the reference steps an
     optimizer over named_parameters only).
+
+    `mask` ([C], optional): survivor mask. Excluded clients' grads are
+    where-zeroed, their similarity rows are masked (see
+    :func:`foolsgold_weights`), and — critically — their feature gradients
+    are NOT written into the cross-round memory: a quarantined NaN payload
+    must not poison the defense's history. mask=None (or all-ones) is the
+    dense rule.
     """
+    if mask is not None:
+        stacked_grads = survivor_sanitize(stacked_grads, mask)
+        feature_grads = jnp.where(mask[:, None] > 0, feature_grads,
+                                  jnp.zeros((), feature_grads.dtype))
     memory = fg_state.memory.at[participant_ids].add(feature_grads)
     current = memory[participant_ids] if use_memory else feature_grads
-    wv, alpha = foolsgold_weights(current)
+    wv, alpha = foolsgold_weights(current, mask=mask)
 
     num_clients = feature_grads.shape[0]
 

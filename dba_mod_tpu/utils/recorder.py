@@ -37,6 +37,12 @@ TRIGGER_HEADER = ["model", "trigger_name", "trigger_value", "epoch",
                   "average_loss", "accuracy", "correct_data", "total_data"]
 BATCH_HEADER = ["local_model", "round", "epoch", "internal_epoch", "batch",
                 "value"]
+# per-round robustness columns (fl/faults.py + the quarantine pass in
+# fl/rounds.py) so PARITY/trajectory harnesses can plot attack success
+# under faults; all-zero when the fault layer is off
+ROUND_HEADER = ["epoch", "global_acc", "global_loss", "backdoor_acc",
+                "n_quarantined", "n_dropped", "n_retries", "degraded",
+                "round_time"]
 
 
 def _tag(name: Any) -> str:
@@ -62,6 +68,7 @@ class Recorder:
         self.scale_temp_one_row: List[Any] = []
         self.batch_loss_result: List[list] = []
         self.batch_distance_result: List[list] = []
+        self.round_result: List[list] = []
         self._jsonl_rows: List[dict] = []
 
     def _scalar(self, tag: str, value: float, step: int):
@@ -134,6 +141,15 @@ class Recorder:
     def add_round_json(self, **kwargs):
         kwargs.setdefault("time", time.time())
         self._jsonl_rows.append(kwargs)
+        if "epoch" in kwargs:
+            self.round_result.append(
+                [kwargs["epoch"], kwargs.get("global_acc"),
+                 kwargs.get("global_loss"), kwargs.get("backdoor_acc"),
+                 int(kwargs.get("n_quarantined", 0) or 0),
+                 int(kwargs.get("n_dropped", 0) or 0),
+                 int(kwargs.get("n_retries", 0) or 0),
+                 int(bool(kwargs.get("degraded", False))),
+                 kwargs.get("round_time")])
         if self._tb is not None and "epoch" in kwargs:
             step = int(kwargs["epoch"])
             for k, v in kwargs.items():
@@ -171,6 +187,8 @@ class Recorder:
         if self.batch_distance_result:
             write("distance_result.csv", BATCH_HEADER,
                   self.batch_distance_result)
+        if self.round_result:
+            write("round_result.csv", ROUND_HEADER, self.round_result)
         if is_poison:
             write("posiontest_result.csv", TEST_HEADER,
                   self.posiontest_result)

@@ -1,0 +1,389 @@
+"""Fault-injection harness + survivor-masked aggregation + retry/degradation.
+
+Three layers of coverage:
+  1. unit — the masked aggregation rules reduce EXACTLY to the dense rules
+     under an all-ones mask, and exclude quarantined (NaN/blown-up) clients
+     without propagating non-finite values;
+  2. the jitted screening pass (finite + norm screens) and the fault plan's
+     determinism/exclusivity;
+  3. end-to-end — injected-NaN rounds recover (finite global model, quarantine
+     counters recorded), injected-dropout rounds degrade gracefully (model
+     carried forward), and the round-level retry restores the captured
+     pre-round state and re-runs with escalated screening.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl import faults as flt
+from dba_mod_tpu.fl.experiment import Experiment, _pad_tasks
+from dba_mod_tpu.fl.rounds import RobustStats, screen_client_updates
+from dba_mod_tpu.fl.state import build_client_tasks
+from dba_mod_tpu.models import ModelVars
+from dba_mod_tpu.ops import aggregation as agg
+
+BASE = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=6, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False, random_seed=1)
+
+
+def _rand_tree(rng, batch=None):
+    shape = lambda *s: (batch,) + s if batch else s
+    return {"dense": {"kernel": rng.randn(*shape(4, 3)).astype(np.float32),
+                      "bias": rng.randn(*shape(3)).astype(np.float32)},
+            "bn": {"mean": rng.randn(*shape(3)).astype(np.float32)}}
+
+
+def _dev(tree):
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+# ---------------------------------------------------- all-ones mask ≡ dense
+def test_masked_fedavg_all_ones_is_dense_bitwise():
+    rng = np.random.RandomState(0)
+    g, deltas = _rand_tree(rng), _dev(_rand_tree(rng, batch=5))
+    ones = jnp.ones((5,), jnp.float32)
+    dense = agg.fedavg_update(g, deltas, 0.8, 5)
+    masked = agg.fedavg_update_masked(g, deltas, 0.8, 5, ones, ones > 0)
+    for d, m in zip(jax.tree_util.tree_leaves(dense),
+                    jax.tree_util.tree_leaves(masked)):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(m))
+
+
+def test_masked_rfa_all_ones_is_dense():
+    rng = np.random.RandomState(1)
+    g, deltas = _rand_tree(rng), _dev(_rand_tree(rng, batch=6))
+    ns = jnp.asarray(np.array([100, 50, 80, 120, 60, 90], np.float32))
+    dense = agg.geometric_median_update(g, deltas, ns, eta=0.1, maxiter=10)
+    masked = agg.geometric_median_update(g, deltas, ns, eta=0.1, maxiter=10,
+                                         mask=jnp.ones((6,), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(dense.wv),
+                                  np.asarray(masked.wv))
+    for d, m in zip(jax.tree_util.tree_leaves(dense.new_state),
+                    jax.tree_util.tree_leaves(masked.new_state)):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(m))
+
+
+def test_masked_foolsgold_all_ones_is_dense():
+    rng = np.random.RandomState(2)
+    params = {"w": jnp.asarray(rng.randn(5, 4).astype(np.float32))}
+    C, L = 4, 12
+    grads = {"w": jnp.asarray(rng.randn(C, 5, 4).astype(np.float32))}
+    feature = jnp.asarray(rng.randn(C, L).astype(np.float32))
+    ids = jnp.asarray([0, 3, 7, 9])
+    st = agg.foolsgold_init(10, L)
+    dense = agg.foolsgold_update(params, grads, feature, ids, st, eta=0.1,
+                                 lr=0.1, momentum=0.9, weight_decay=5e-4)
+    masked = agg.foolsgold_update(params, grads, feature, ids, st, eta=0.1,
+                                  lr=0.1, momentum=0.9, weight_decay=5e-4,
+                                  mask=jnp.ones((C,), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(dense.wv), np.asarray(masked.wv))
+    np.testing.assert_array_equal(np.asarray(dense.new_params["w"]),
+                                  np.asarray(masked.new_params["w"]))
+    np.testing.assert_array_equal(np.asarray(dense.new_fg_state.memory),
+                                  np.asarray(masked.new_fg_state.memory))
+
+
+# -------------------------------------------------- masked exclusion works
+def test_masked_fedavg_excludes_nan_client_and_renormalizes():
+    rng = np.random.RandomState(3)
+    g = _rand_tree(rng)
+    deltas_np = _rand_tree(rng, batch=4)
+    for leaf in jax.tree_util.tree_leaves(deltas_np):
+        leaf[1] = np.nan  # client 1's payload is corrupt
+    mask = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+    new = agg.fedavg_update_masked(g, _dev(deltas_np), 0.8, 4, mask,
+                                   jnp.ones((4,), bool))
+    # renormalized over 3 survivors, NaN row fully excluded
+    for path in [("dense", "kernel"), ("dense", "bias"), ("bn", "mean")]:
+        got = np.asarray(new[path[0]][path[1]])
+        surv = np.delete(deltas_np[path[0]][path[1]], 1, axis=0)
+        exp = g[path[0]][path[1]] + 0.8 / 3 * surv.sum(0)
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_masked_rfa_excludes_nan_client():
+    rng = np.random.RandomState(4)
+    g = _rand_tree(rng)
+    deltas_np = _rand_tree(rng, batch=5)
+    for leaf in jax.tree_util.tree_leaves(deltas_np):
+        leaf[0] = np.inf
+    mask = jnp.asarray([0.0, 1.0, 1.0, 1.0, 1.0])
+    res = agg.geometric_median_update(
+        g, _dev(deltas_np), jnp.full((5,), 10.0), eta=1.0, mask=mask)
+    for leaf in jax.tree_util.tree_leaves(res.new_state):
+        assert np.isfinite(np.asarray(leaf)).all()
+    wv = np.asarray(res.wv)
+    assert wv[0] == 0.0 and np.isfinite(wv).all()
+    # excluded client gets zero Weiszfeld weight; survivors share the mass
+    np.testing.assert_allclose(wv.sum(), 1.0, rtol=1e-5)
+
+
+def test_masked_foolsgold_excludes_nan_client_and_protects_memory():
+    rng = np.random.RandomState(5)
+    params = {"w": jnp.asarray(rng.randn(5, 4).astype(np.float32))}
+    C, L = 4, 12
+    grads_np = rng.randn(C, 5, 4).astype(np.float32)
+    feature_np = rng.randn(C, L).astype(np.float32)
+    grads_np[2], feature_np[2] = np.nan, np.nan
+    ids = jnp.asarray([0, 1, 2, 3])
+    st = agg.foolsgold_init(10, L)
+    mask = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+    res = agg.foolsgold_update(params, {"w": jnp.asarray(grads_np)},
+                               jnp.asarray(feature_np), ids, st, eta=0.1,
+                               lr=0.1, momentum=0.9, weight_decay=5e-4,
+                               mask=mask)
+    assert np.isfinite(np.asarray(res.new_params["w"])).all()
+    wv = np.asarray(res.wv)
+    assert wv[2] == 0.0 and np.isfinite(wv).all()
+    # the quarantined client's NaN feature must NOT poison the memory
+    mem = np.asarray(res.new_fg_state.memory)
+    assert np.isfinite(mem).all() and (mem[2] == 0).all()
+
+
+# ------------------------------------------------------------ screening pass
+def _stack_vars(rng, C):
+    t = _rand_tree(rng, batch=C)
+    return ModelVars(params=_dev({"dense": t["dense"]}),
+                     batch_stats=_dev({"bn": t["bn"]}))
+
+
+def test_screen_catches_nonfinite_and_norm_blowup():
+    rng = np.random.RandomState(6)
+    deltas = _stack_vars(rng, 6)
+    bad = jax.tree_util.tree_map(lambda l: l.at[1].set(jnp.nan), deltas)
+    bad = jax.tree_util.tree_map(lambda l: l.at[2].multiply(1e6), bad)
+    ones = jnp.ones((6,), bool)
+    # norm screen off: only the NaN client is quarantined
+    mask, norms = screen_client_updates(bad, ones, ones, jnp.float32(0.0))
+    assert list(np.asarray(mask)) == [True, False, True, True, True, True]
+    # norm screen at 10x median: the blowup client goes too
+    mask, _ = screen_client_updates(bad, ones, ones, jnp.float32(10.0))
+    assert list(np.asarray(mask)) == [True, False, False, True, True, True]
+    # a client that never reported is excluded regardless of screens
+    reported = ones.at[4].set(False)
+    mask, _ = screen_client_updates(bad, reported, ones, jnp.float32(0.0))
+    assert not bool(mask[4])
+
+
+def test_fault_plan_deterministic_and_exclusive():
+    fcfg = flt.FaultConfig(enabled=True, dropout_prob=0.3, corrupt_prob=0.3,
+                           blowup_prob=0.3, blowup_factor=1e8,
+                           stale_prob=0.3, seed=0)
+    counted = jnp.ones((64,), bool).at[60:].set(False)
+    key = jax.random.key(7)
+    p1 = flt.make_fault_plan(fcfg, key, counted)
+    p2 = flt.make_fault_plan(fcfg, key, counted)
+    for a, b in zip(p1, p2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    lanes = np.stack([np.asarray(x) for x in p1])
+    assert (lanes.sum(0) <= 1).all()          # mutually exclusive
+    assert not lanes[:, 60:].any()            # padding lanes never fault
+    assert lanes.any()                        # p=0.3 x4 over 60 lanes: some hit
+
+
+def test_perturb_tree_lanes():
+    fcfg = flt.FaultConfig(enabled=True, dropout_prob=0, corrupt_prob=0,
+                           blowup_prob=0, blowup_factor=100.0, stale_prob=0,
+                           seed=0)
+    plan = flt.FaultPlan(dropped=jnp.asarray([True, False, False, False]),
+                         corrupt=jnp.asarray([False, True, False, False]),
+                         blowup=jnp.asarray([False, False, True, False]),
+                         stale=jnp.asarray([False, False, False, True]))
+    x = jnp.ones((4, 3))
+    stale = jnp.full((4, 3), 7.0)
+    out = np.asarray(flt.perturb_tree(x, plan, fcfg, stale))
+    assert (out[0] == 0).all()
+    assert np.isnan(out[1]).all()
+    assert (out[2] == 100.0).all()
+    assert (out[3] == 7.0).all()
+    # int leaves pass through untouched
+    ints = jnp.ones((4, 3), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(flt.perturb_tree(ints, plan, fcfg)), np.asarray(ints))
+
+
+# ------------------------------------------------------------------ config
+def test_config_validation():
+    with pytest.raises(ValueError, match="screen_updates"):
+        Params.from_dict(dict(BASE, screen_updates="yes"))
+    with pytest.raises(ValueError, match="min_surviving"):
+        Params.from_dict(dict(BASE, min_surviving_clients=0))
+    with pytest.raises(ValueError, match="fault_corrupt_prob"):
+        e = Experiment(Params.from_dict(dict(
+            BASE, fault_injection=True, fault_corrupt_prob=1.5)),
+            save_results=False)
+
+
+def test_pad_tasks_rejects_non_fedavg():
+    p = Params.from_dict(BASE)
+    tasks = build_client_tasks(p, [0, 1], 1, np.zeros(2, np.int64), 1, None)
+    padded = _pad_tasks(tasks, 2, "mean")
+    assert padded.slot.shape == (4,)
+    with pytest.raises(ValueError, match="only sound for FedAvg"):
+        _pad_tasks(tasks, 2, "geom_median")
+
+
+# -------------------------------------------------------------- end-to-end
+def _run(params_dict, rounds):
+    e = Experiment(Params.from_dict(params_dict), save_results=False)
+    return e, [e.run_round(i) for i in range(1, rounds + 1)]
+
+
+def _params_finite(e):
+    return all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(e.global_vars))
+
+
+def test_no_faults_screening_matches_dense_run():
+    """Regression: the robust round program with nothing to quarantine must
+    produce the same trajectory as the dense program (all-ones mask)."""
+    e_dense, r_dense = _run(dict(BASE), 3)
+    e_robust, r_robust = _run(dict(BASE, screen_updates=True), 3)
+    for a, b in zip(jax.tree_util.tree_leaves(e_dense.global_vars),
+                    jax.tree_util.tree_leaves(e_robust.global_vars)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    assert [r["global_acc"] for r in r_dense] == pytest.approx(
+        [r["global_acc"] for r in r_robust], abs=1e-3)
+    assert all(r["n_quarantined"] == 0 and not r["degraded"]
+               for r in r_robust)
+
+
+@pytest.mark.parametrize("aggregation", ["mean", "geom_median", "foolsgold"])
+def test_injected_nan_never_reaches_global_model(aggregation):
+    """Acceptance: an injected NaN-delta round never propagates non-finite
+    values into the global model, under every aggregation rule."""
+    e, results = _run(dict(BASE, aggregation_methods=aggregation,
+                           fault_injection=True, fault_corrupt_prob=0.4,
+                           fault_seed=3), 3)
+    assert _params_finite(e)
+    assert sum(r["n_quarantined"] for r in results) > 0
+    assert all(np.isfinite(r["global_acc"]) for r in results)
+
+
+def test_injected_dropout_degrades_gracefully():
+    """All clients dropping out leaves too few survivors: aggregation is
+    skipped, the global model is carried forward, the round is degraded."""
+    e = Experiment(Params.from_dict(dict(
+        BASE, fault_injection=True, fault_dropout_prob=1.0)),
+        save_results=False)
+    before = jax.device_get(e.global_vars)
+    r = e.run_round(1)
+    assert r["degraded"] and r["n_dropped"] == 4
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(jax.device_get(e.global_vars))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # recorder: degraded round lands in the round CSV columns
+    row = dict(zip(
+        ["epoch", "global_acc", "global_loss", "backdoor_acc",
+         "n_quarantined", "n_dropped", "n_retries", "degraded",
+         "round_time"], e.recorder.round_result[-1]))
+    assert row["degraded"] == 1 and row["n_dropped"] == 4
+
+
+def test_partial_dropout_renormalizes_and_learns():
+    e, results = _run(dict(BASE, fault_injection=True,
+                           fault_dropout_prob=0.3, fault_seed=5,
+                           internal_epochs=2), 8)
+    assert _params_finite(e)
+    assert sum(r["n_dropped"] for r in results) > 0
+    assert results[-1]["global_acc"] > 25.0  # still learns under dropout
+
+
+def test_stale_replay_of_zero_history_is_identity():
+    """stale_prob=1: every client replays the previous round's submitted
+    delta; before any round that history is zero, so the global model is
+    carried unchanged — deterministic check of the replay lane."""
+    e = Experiment(Params.from_dict(dict(
+        BASE, fault_injection=True, fault_stale_prob=1.0)),
+        save_results=False)
+    before = jax.device_get(e.global_vars)
+    e.run_round(1)
+    e.run_round(2)  # round 2 replays round 1's (zero) submitted deltas
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(jax.device_get(e.global_vars))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _corrupting_round_fn(real_fn, fail_times):
+    """Wrap the engine's round program: the first `fail_times` invocations
+    return a NaN global model with global_finite=False — a deterministic
+    stand-in for aggregation overflow that screening could not prevent."""
+    calls = {"n": 0}
+
+    def wrapped(*args):
+        new_vars, new_fg, payload, deltas_out = real_fn(*args)
+        calls["n"] += 1
+        if calls["n"] <= fail_times:
+            new_vars = jax.tree_util.tree_map(
+                lambda l: l * jnp.nan, new_vars)
+            stats = payload[9]._replace(global_finite=jnp.asarray(False))
+            payload = payload[:9] + (stats,)
+        return new_vars, new_fg, payload, deltas_out
+
+    return wrapped, calls
+
+
+def test_round_retry_recovers_from_nonfinite_aggregate():
+    e = Experiment(Params.from_dict(dict(
+        BASE, screen_updates=True, max_round_retries=2)),
+        save_results=False)
+    e.engine.round_fn, calls = _corrupting_round_fn(e.engine.round_fn, 1)
+    r = e.run_round(1)
+    assert calls["n"] == 2          # original attempt + one retry
+    assert r["n_retries"] == 1 and not r["degraded"]
+    assert _params_finite(e)
+
+
+def test_round_retry_exhaustion_forces_degraded_round():
+    e = Experiment(Params.from_dict(dict(
+        BASE, screen_updates=True, max_round_retries=1)),
+        save_results=False)
+    before = jax.device_get(e.global_vars)
+    e.engine.round_fn, calls = _corrupting_round_fn(e.engine.round_fn, 99)
+    r = e.run_round(1)
+    assert calls["n"] == 2          # original attempt + one retry
+    assert r["n_retries"] == 1 and r["degraded"]
+    assert _params_finite(e)
+    assert np.isfinite(r["global_acc"])  # battery re-ran on restored model
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(jax.device_get(e.global_vars))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_norm_blowup_quarantined_by_norm_screen():
+    e, results = _run(dict(BASE, fault_injection=True,
+                           fault_blowup_prob=0.4, fault_blowup_factor=1e6,
+                           screen_norm_mult=10.0, fault_seed=11), 3)
+    assert _params_finite(e)
+    assert sum(r["n_quarantined"] for r in results) > 0
+
+
+@pytest.mark.slow
+def test_backdoor_attack_under_faults_mesh():
+    """Reference-scale rehearsal: the poison pathway with dropout + NaN
+    faults on the 8-device mesh — survivor-masked FedAvg on a sharded
+    clients axis, plus the robust counters flowing into the recorder."""
+    poison = dict(
+        BASE, no_models=8, internal_epochs=1, internal_poison_epochs=2,
+        is_poison=True, local_eval=True, poison_label_swap=2,
+        poisoning_per_batch=8, poison_lr=0.05, scale_weights_poison=4.0,
+        adversary_list=[0, 1], trigger_num=2, alpha_loss=1.0,
+        num_devices=-1, fault_injection=True, fault_dropout_prob=0.15,
+        fault_corrupt_prob=0.15, fault_seed=2,
+        **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2], [0, 3]],
+           "1_poison_pattern": [[3, 0], [3, 1], [3, 2], [3, 3]],
+           "0_poison_epochs": [2, 3, 4], "1_poison_epochs": [3, 4]})
+    e, results = _run(poison, 5)
+    assert _params_finite(e)
+    assert all(np.isfinite(r["global_acc"]) for r in results)
+    faulted = sum(r["n_dropped"] + r["n_quarantined"] for r in results)
+    assert faulted > 0
