@@ -2,7 +2,7 @@
 # command: the fast CPU suite (slow-marked rehearsals deselected) on the
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
-.PHONY: tier1 test-slow
+.PHONY: tier1 test-slow trace
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -16,3 +16,12 @@ tier1:
 test-slow:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m slow \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# One short telemetry-instrumented run (telemetry + profile_dir on): writes
+# telemetry.jsonl + Chrome-trace trace.json into the run folder, the XLA
+# profiler dump into runs/trace_profile, and prints the phase summary.
+trace:
+	env JAX_PLATFORMS=cpu python -m dba_mod_tpu.main \
+	  --params configs/trace_params.yaml
+	@echo "telemetry files:"; ls -1 runs/mnist_*/telemetry.jsonl \
+	  runs/mnist_*/trace.json 2>/dev/null | tail -2

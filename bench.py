@@ -77,6 +77,7 @@ def _make_experiment(config=None):
                      save_results=False)
     exp.warm_step_buckets()   # compile every dynamic-steps shape up front
     exp.run_round(1)          # compile eval/aggregate programs
+    exp.telemetry.mark_warm()  # further XLA compiles are regressions
     return exp
 
 
@@ -226,10 +227,25 @@ def main() -> int:
     ap.add_argument("--no-tiny", action="store_true",
                     help="skip the Tiny-ImageNet second lane")
     ap.add_argument("--tiny-rounds", type=int, default=4)
+    ap.add_argument("--telemetry", metavar="DIR", default="",
+                    help="enable the telemetry layer (utils/telemetry.py): "
+                         "writes telemetry.jsonl + Chrome-trace trace.json "
+                         "to DIR and prints the phase summary to stderr. "
+                         "NOTE: telemetry adds per-phase device syncs, so "
+                         "the headline rounds/sec is NOT comparable to an "
+                         "uninstrumented run")
     args = ap.parse_args()
 
-    exp = _make_experiment()
+    config = dict(BENCH_CONFIG)
+    if args.telemetry:
+        config.update(telemetry=True, telemetry_dir=args.telemetry)
+    exp = _make_experiment(config)
     ours = measure_ours(exp, args.rounds)
+    # snapshot now: the phases probe below intentionally compiles the
+    # static-plan-shape programs post-warmup, which would pollute the
+    # steady-state regression count reported in out["telemetry"]
+    steady_recompiles = exp.telemetry.counter(
+        "xla/recompiles_after_warmup").value
     base = baseline_seconds_per_round(args.skip_baseline)
     rounds_per_sec = 1.0 / ours
     vs = (base / ours) if base else 1.0
@@ -269,6 +285,19 @@ def main() -> int:
                         "buckets"}
         except Exception as e:  # noqa: BLE001 — diagnostics must not
             out["phases_error"] = str(e)  # break the headline number
+
+    if args.telemetry:
+        # final trace/summary flush for the headline lane (the tiny lane
+        # below builds its own un-instrumented Experiment); summary goes to
+        # stderr — stdout stays the single JSON line
+        exp.telemetry.record_memory()
+        exp.telemetry.close()
+        print(exp.telemetry.summary_table(), file=sys.stderr)
+        out["telemetry"] = {
+            "dir": args.telemetry,
+            "recompiles_after_warmup": steady_recompiles,
+            "note": "per-phase device syncs active; value above is NOT "
+                    "comparable to an uninstrumented run"}
 
     if not args.no_tiny:
         # lane 2: heavier per-round, fewer timed rounds amortize fine

@@ -32,6 +32,7 @@ import jax
 import numpy as np
 
 from dba_mod_tpu.models import ModelVars
+from dba_mod_tpu.utils import telemetry
 
 AUX_SUFFIX = ".aux.pkl"
 
@@ -49,8 +50,9 @@ def _get_async_checkpointer():
 def wait_for_async_saves() -> None:
     """Block until every in-flight async checkpoint commit has landed."""
     if _async_ckptr is not None:
-        _async_ckptr.wait_until_finished()
-        _async_ckptr.check_for_errors()
+        with telemetry.span("checkpoint/wait_async"):
+            _async_ckptr.wait_until_finished()
+            _async_ckptr.check_for_errors()
 
 
 def save_checkpoint(path: str | Path, model_vars: ModelVars, epoch: int,
@@ -62,12 +64,18 @@ def save_checkpoint(path: str | Path, model_vars: ModelVars, epoch: int,
                "batch_stats": model_vars.batch_stats,
                "epoch": np.asarray(epoch, np.int64),
                "lr": np.asarray(lr, np.float64)}
+    # the async span covers only the enqueue (the commit runs in orbax's
+    # background thread — checkpoint/wait_async is where it lands); the
+    # sync span covers the whole write
+    telemetry.count("checkpoint/saves")
     if async_save:
-        _get_async_checkpointer().save(
-            path, args=ocp.args.StandardSave(payload), force=True)
+        with telemetry.span("checkpoint/save_async_enqueue"):
+            _get_async_checkpointer().save(
+                path, args=ocp.args.StandardSave(payload), force=True)
     else:
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, payload, force=True)
+        with telemetry.span("checkpoint/save"):
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(path, payload, force=True)
 
 
 def load_checkpoint(path: str | Path,
@@ -79,8 +87,10 @@ def load_checkpoint(path: str | Path,
                                                       like.batch_stats),
                 "epoch": np.asarray(0, np.int64),
                 "lr": np.asarray(0, np.float64)}
-    with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, abstract)
+    telemetry.count("checkpoint/loads")
+    with telemetry.span("checkpoint/load"):
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(path, abstract)
     mv = ModelVars(
         params=jax.tree_util.tree_map(jax.numpy.asarray, restored["params"]),
         batch_stats=jax.tree_util.tree_map(jax.numpy.asarray,

@@ -88,6 +88,18 @@ _DEFAULTS: Dict[str, Any] = {
                                    # image_train.py:150-164, 268-299)
     "profile_dir": "",             # non-empty: jax.profiler traces per round
     "tensorboard": False,          # scalar summaries (imports TensorFlow)
+    "telemetry": False,            # span tracing + metrics registry + XLA
+                                   # compile/memory instrumentation
+                                   # (utils/telemetry.py): writes
+                                   # telemetry.jsonl + Chrome-trace
+                                   # trace.json per run, adds honest
+                                   # device-sync points to phase spans
+                                   # (serializes round pipelining); off =
+                                   # no files, no per-round work beyond a
+                                   # no-op check
+    "telemetry_dir": "",           # where telemetry files land; "" = the
+                                   # run folder (in-memory only when the
+                                   # run saves no results)
     "sequential_debug": False,     # run clients one-by-one (A/B vs vmapped)
     "data_dir": "./data",
     "synthetic_data": False,       # force the synthetic dataset backend

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from dba_mod_tpu.models import ModelDef, ModelVars
 from dba_mod_tpu.fl.device_data import DeviceData
 from dba_mod_tpu.ops.losses import cross_entropy_sum
+from dba_mod_tpu.utils import telemetry
 
 
 class EvalResult(NamedTuple):
@@ -35,6 +36,21 @@ class EvalResult(NamedTuple):
     acc: jax.Array       # percentage
     correct: jax.Array
     count: jax.Array     # dataset_size / poison_data_count
+
+
+def instrument_eval(fn, name: str, batches: int = 0):
+    """Telemetry wrapper for a compiled eval battery: each call runs under a
+    span with an explicit device sync (``jax.block_until_ready`` on the
+    results — under async dispatch the un-synced call time is just the
+    enqueue), and counts `batches` scan steps into ``eval/batches``.
+
+    A zero-overhead passthrough while telemetry is off, so the standalone
+    batteries keep deferring their sync to ``finalize_round`` and round
+    pipelining is unaffected. With telemetry on, evals that run outside the
+    fused round program (the split-phase dispatch, sequential_debug, the
+    degraded-round re-eval, the LOAN backdoor probe) report honest phase
+    times at the cost of syncing where they are called."""
+    return telemetry.instrument(fn, name, batches=batches)
 
 
 def make_eval_fn(model_def: ModelDef, data: DeviceData, poison: bool):

@@ -33,6 +33,7 @@ from dba_mod_tpu.fl.selection import select_agents
 from dba_mod_tpu.fl.state import build_client_tasks
 from dba_mod_tpu.models import ModelVars, build_model, compute_dtype_of
 from dba_mod_tpu.ops.aggregation import foolsgold_init
+from dba_mod_tpu.utils import telemetry
 from dba_mod_tpu.utils.recorder import Recorder
 
 logger = logging.getLogger("dba_mod_tpu")
@@ -70,13 +71,17 @@ class RoundInFlight:
     behind round N's host fetch (the tunnel round-trip is ~100 ms — hiding it
     is worth ~10% of a bench round)."""
     epoch: int
-    t0: float
+    t0: float                    # perf_counter at dispatch start
     seg_epochs: List[int]
     agent_names: List[Any]
     adv_names: List[Any]
     tasks_list: List[Any]
     mask_list: List[Any]
     payload: Any                 # device trees handed to jax.device_get
+    # host planning + enqueue seconds (perf_counter), set by dispatch_round;
+    # finalize_round records it next to its own fetch time so
+    # round_result.csv splits round_time into honest phases
+    dispatch_time: float = 0.0
     # fault-tolerance outcome of the dispatch (fl/faults.py + the screening
     # pass in fl/rounds.py): retries consumed re-running the round after a
     # non-finite aggregate, and whether the host forced a degraded round
@@ -99,15 +104,30 @@ class Experiment:
         self.params = params
         self.folder: Optional[Path] = (params.make_run_folder()
                                        if save_results else None)
-        if self.folder and not logger.handlers:
-            logging.basicConfig(level=logging.INFO)
-            logger.addHandler(logging.FileHandler(self.folder / "log.txt"))
+        # idempotent logger setup (telemetry.py): one stream handler, one
+        # run-folder file handler that FOLLOWS the active experiment —
+        # replaces the old basicConfig + per-instance FileHandler stacking
+        # (two experiments in one process each logged every line twice)
+        telemetry.setup_logging(self.folder)
         if self.folder:
             from dba_mod_tpu.utils.html import dict_html
             (self.folder / "params.html").write_text(
                 dict_html(params.raw, params.current_time))
         self.recorder = Recorder(self.folder,
                                  tensorboard=bool(params.get("tensorboard")))
+        # telemetry (utils/telemetry.py): spans + metrics + XLA compile and
+        # memory instrumentation. Files land in telemetry_dir (default: the
+        # run folder; in-memory when neither exists); one writer per
+        # multi-process run. The instance is process-wide current, so spans
+        # in shared code paths (checkpoint.py, rounds.py) resolve to it.
+        tdir = str(params.get("telemetry_dir", "") or "")
+        tfolder: Optional[Path] = Path(tdir) if tdir else self.folder
+        if tfolder is not None and jax.process_index() != 0:
+            tfolder = None
+        self.telemetry = telemetry.configure(
+            enabled=bool(params.get("telemetry", False)), folder=tfolder,
+            tb_sink=(self.recorder._scalar
+                     if self.recorder._tb is not None else None))
         self.model_def = build_model(params)
         seed = int(params.get("random_seed", 1))
         self.select_rng = random.Random(seed)
@@ -397,10 +417,17 @@ class Experiment:
                     self.mesh, tasks_seq, idx, mask, ns)
             for attempt in (1, 2):
                 try:
-                    # warm the fused round program — the one real rounds run
-                    self.engine.round_fn(self.global_vars, self.fg_state,
-                                         tasks_seq, idx, mask, lane, ns,
-                                         rng_t, rng_a, *robust_args)
+                    # warm the program real rounds run: the fused round —
+                    # or, under telemetry's split-phase dispatch, the train
+                    # program (the only split program whose shape varies
+                    # with the step bucket; aggregate/eval are bucket-free)
+                    if self._telemetry_split and not self.sequential_debug:
+                        self.engine.train_fn(self.global_vars, tasks_seq,
+                                             idx, mask, lane, rng_t)
+                    else:
+                        self.engine.round_fn(self.global_vars, self.fg_state,
+                                             tasks_seq, idx, mask, lane, ns,
+                                             rng_t, rng_a, *robust_args)
                     self._warmed_buckets.add(s)
                     break
                 except Exception as exc:  # noqa: BLE001 — the TPU
@@ -450,16 +477,42 @@ class Experiment:
     def run_round(self, epoch: int) -> Dict[str, Any]:
         return self.finalize_round(self.dispatch_round(epoch))
 
+    @property
+    def _telemetry_split(self) -> bool:
+        """Split-phase dispatch only while THIS experiment's telemetry is
+        the process-wide current instance: the shared eval/checkpoint
+        wrappers resolve ``telemetry.current()`` at call time, so after
+        another Experiment takes over, the split path would pay its
+        per-phase device syncs with no spans recorded — fall back to the
+        fused program (whose dispatch/finalize spans, recorded on this
+        instance, stay honest: host planning + enqueue / blocking fetch)."""
+        return (self.telemetry.enabled and not self.engine.robust
+                and telemetry.current() is self.telemetry)
+
     def dispatch_round(self, epoch: int) -> RoundInFlight:
+        """Telemetry/timing shell around :meth:`_dispatch`: the whole host
+        planning + enqueue runs under the ``round/dispatch`` span, and its
+        perf_counter duration lands in ``round_result.csv`` as
+        ``dispatch_time`` (the old single `round_time` measured with
+        ``time.time()`` attributed pipelined fetches to whatever wall
+        segment they landed in)."""
+        t0 = time.perf_counter()
+        self.telemetry.set_epoch(epoch)
+        with self.telemetry.span("round/dispatch"):
+            fl = self._dispatch(epoch, t0)
+        fl.dispatch_time = time.perf_counter() - t0
+        return fl
+
+    def _dispatch(self, epoch: int, t0: float) -> RoundInFlight:
         """Host-side planning + every device dispatch for one round; no host
         sync — EXCEPT the LOAN adaptive-poison probe below, which must read
         the current global model's backdoor accuracy (loan_train.py:67-75)
         and therefore blocks on all previously dispatched work (pipelining
-        degrades to sequential for those rounds, by necessity). The returned
-        handle feeds `finalize_round`, which performs the round's single
-        blocking transfer and the CSV/JSONL recording."""
+        degrades to sequential for those rounds, by necessity), and the
+        explicit per-phase sync points of telemetry's split-phase path. The
+        returned handle feeds `finalize_round`, which performs the round's
+        single blocking transfer and the CSV/JSONL recording."""
         params = self.params
-        t0 = time.time()
         agent_names, adv_names = select_agents(
             params, epoch, self.participants, self.benign_names,
             self.select_rng)
@@ -546,7 +599,16 @@ class Experiment:
         self.rng_key, round_key = jax.random.split(self.rng_key)
         rng_train, rng_agg = jax.random.split(round_key)
         lane = jnp.arange(idx_seq.shape[1], dtype=jnp.int32)
-        if not self.sequential_debug:
+        # Three dispatch shapes: the fused round (one program, one dispatch —
+        # the perf path), the robust fused round (adds the screening sync +
+        # host retry loop), and the SPLIT path — clients-one-by-one for
+        # sequential_debug, or vmapped-per-phase when telemetry is on: the
+        # fused round is a single XLA program, so honest per-phase times
+        # require running train/aggregate/evals as separate programs with an
+        # explicit sync each (the same programs sequential_debug and
+        # bench.py's phase probe already exercise).
+        use_split = self.sequential_debug or self._telemetry_split
+        if not use_split:
             if self.engine.robust:
                 return self._dispatch_robust(
                     epoch, t0, seg_epochs, agent_names, adv_names,
@@ -565,18 +627,44 @@ class Experiment:
                 vars_after=new_vars, fg_after=new_fg,
                 rng_after=self._snapshot_rng())
 
-        train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
-                                       rng_train)
+        if self.sequential_debug:
+            train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
+                                           rng_train)
+        else:
+            with self.telemetry.span("round/train"):
+                train = self.engine.train_fn(self.global_vars, tasks_seq,
+                                             idx_seq, mask_seq, lane,
+                                             rng_train)
+                self.telemetry.sync(train.deltas)
+        return self._finish_split_round(epoch, t0, seg_epochs, agent_names,
+                                        adv_names, tasks_list, mask_list,
+                                        tasks_seq, mask_seq, ns_dev,
+                                        rng_agg, train)
+
+    def _finish_split_round(self, epoch, t0, seg_epochs, agent_names,
+                            adv_names, tasks_list, mask_list, tasks_seq,
+                            mask_seq, ns_dev, rng_agg,
+                            train) -> RoundInFlight:
+        """Aggregate + eval batteries + payload assembly for the split
+        dispatch paths (sequential_debug and telemetry's per-phase mode) —
+        the same tail the fused round program runs on device."""
+        params = self.params
         tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
         tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
         from dba_mod_tpu.fl.rounds import nbt_client_deltas
-        result = self.engine.aggregate_fn(
-            self.global_vars, self.fg_state, train.deltas, train.fg_grads,
-            train.fg_feature, tasks_first.participant_id, ns_dev, rng_agg,
-            nbt_client_deltas(mask_seq, tasks_seq.scale))
+        with self.telemetry.span("round/aggregate"):
+            result = self.engine.aggregate_fn(
+                self.global_vars, self.fg_state, train.deltas,
+                train.fg_grads, train.fg_feature,
+                tasks_first.participant_id, ns_dev, rng_agg,
+                nbt_client_deltas(mask_seq, tasks_seq.scale))
+            self.telemetry.sync(result.new_vars)
 
         # dispatch every eval before any host sync — one blocking transfer,
-        # deferred to finalize_round so a caller can overlap the next round
+        # deferred to finalize_round so a caller can overlap the next round.
+        # (With telemetry on, the instrumented batteries sync here instead:
+        # honest eval/local + eval/global span times in exchange for the
+        # pipeline overlap.)
         prev_deltas = (train.seg_deltas[-1] if train.seg_deltas else
                        jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
         locals_dev = (self.engine.local_evals_fn(
@@ -659,13 +747,18 @@ class Experiment:
         while True:
             extra = self._robust_round_args(epoch, C, norm_mult=norm_mult,
                                             use_carry=True)
-            new_vars, new_fg, payload, deltas_out = self.engine.round_fn(
-                vars_before, fg_before, tasks_seq, idx_seq, mask_seq, lane,
-                ns_dev, rng_train, rng_agg, *extra)
+            # the robust round stays ONE fused program (the screening sync
+            # below is the pipeline cost it already pays) — telemetry times
+            # it as a single round/compute span per attempt
+            with self.telemetry.span("round/compute"):
+                new_vars, new_fg, payload, deltas_out = self.engine.round_fn(
+                    vars_before, fg_before, tasks_seq, idx_seq, mask_seq,
+                    lane, ns_dev, rng_train, rng_agg, *extra)
             if not self.engine.screening:
                 finite = True  # unscreened injection: faults flow through
                 break
-            finite = bool(payload[9].global_finite)  # the one host sync
+            with self.telemetry.span("round/screen_sync"):
+                finite = bool(payload[9].global_finite)  # the one host sync
             if finite or retries >= self.max_round_retries:
                 break
             retries += 1
@@ -712,9 +805,20 @@ class Experiment:
                 "rng_key": np.asarray(jax.random.key_data(self.rng_key))}
 
     def finalize_round(self, fl: RoundInFlight) -> Dict[str, Any]:
-        (locals_, globals_, metrics, delta_norms, wv, alpha,
-         batches, is_updated, seg_locals, rstats) = jax.device_get(
-             fl.payload)
+        t_fin = time.perf_counter()
+        self.telemetry.set_epoch(fl.epoch)
+        with self.telemetry.span("round/finalize"):
+            (locals_, globals_, metrics, delta_norms, wv, alpha,
+             batches, is_updated, seg_locals, rstats) = jax.device_get(
+                 fl.payload)
+        finalize_time = time.perf_counter() - t_fin
+        # perf_counter durations (the old time.time() delta could jump under
+        # clock adjustments); under pipeline_rounds round_time spans the
+        # overlap with the next round's dispatch — dispatch_time and
+        # finalize_time are the honest per-phase components
+        times = {"round_time": time.perf_counter() - fl.t0,
+                 "dispatch_time": fl.dispatch_time,
+                 "finalize_time": finalize_time}
         self.last_is_updated = bool(is_updated)
         self.last_global_loss = float(globals_.clean.loss)
         if self.is_poison_run:
@@ -731,13 +835,36 @@ class Experiment:
                                   or bool(fl.forced_degraded))
         self._record(fl.epoch, fl.seg_epochs, fl.agent_names, fl.adv_names,
                      fl.tasks_list, metrics, locals_, globals_, delta_norms,
-                     wv, alpha, fl.t0, batches, fl.mask_list, seg_locals,
+                     wv, alpha, times, batches, fl.mask_list, seg_locals,
                      robust)
+        self._flush_round_telemetry(fl, robust, delta_norms, times)
         return {"epoch": fl.epoch, "agents": fl.agent_names,
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
                                  if self.is_poison_run else None),
-                "round_time": time.time() - fl.t0, **robust}
+                **times, **robust}
+
+    def _flush_round_telemetry(self, fl: RoundInFlight, robust: Dict[str,
+                               Any], delta_norms, times) -> None:
+        """Per-round metrics-registry update + flush: one telemetry.jsonl
+        line carrying the round's counters/gauges and the span-duration and
+        delta-norm histogram windows (mirrored to TB when wired)."""
+        t = self.telemetry
+        if not t.enabled:
+            return
+        t.counter("rounds").inc()
+        if fl.n_retries:
+            t.counter("round_retries").inc(fl.n_retries)
+        if robust.get("n_quarantined"):
+            t.counter("clients_quarantined").inc(robust["n_quarantined"])
+        if robust.get("n_dropped"):
+            t.counter("clients_dropped").inc(robust["n_dropped"])
+        if robust.get("degraded"):
+            t.counter("degraded_rounds").inc()
+        for n in np.asarray(delta_norms).reshape(-1):
+            t.histogram("delta_norm").observe(float(n))
+        t.histogram("round_seconds").observe(times["round_time"])
+        t.flush_round(fl.epoch)
 
     def _train_sequential(self, tasks_seq, idx_seq, mask_seq, rng):
         """Sequential debug mode (SURVEY §7.2.4): run clients one at a time
@@ -771,7 +898,7 @@ class Experiment:
 
     # ------------------------------------------------------------- recording
     def _record(self, epoch, seg_epochs, agent_names, adv_names, tasks_list,
-                metrics, locals_, globals_, delta_norms, wv, alpha, t0,
+                metrics, locals_, globals_, delta_norms, wv, alpha, times,
                 batches=None, mask_list=None, seg_locals=None, robust=None):
         # metrics leaves are [I, C, E]; tasks_list one ClientTask per segment.
         # Local clean evals: final segment from locals_, intermediate
@@ -948,8 +1075,7 @@ class Experiment:
             global_loss=float(globals_.clean.loss),
             backdoor_acc=(float(globals_.poison.acc)
                           if self.is_poison_run else None),
-            round_time=time.time() - t0,
-            **(robust or {}))
+            **times, **(robust or {}))
         rec.save(self.is_poison_run)
 
     # ------------------------------------------------------------------- run
@@ -963,58 +1089,92 @@ class Experiment:
         params = self.params
         if not params["save_model"] or self.folder is None:
             return
-        model_vars = fl.vars_after if fl is not None else self.global_vars
-        fg_state = fl.fg_after if fl is not None else self.fg_state
-        rng = fl.rng_after if fl is not None else self._snapshot_rng()
-        path = self.folder / "model_last.pt.tar"
-        lr = float(params["lr"])
-        written = [path]
-        ckpt.save_checkpoint(path, model_vars, epoch, lr,
-                             async_save=async_save)
-        if epoch in list(params["save_on_epochs"]):
-            p = Path(str(path) + f".epoch_{epoch}")
-            ckpt.save_checkpoint(p, model_vars, epoch, lr,
+        with self.telemetry.span("round/checkpoint"):
+            model_vars = fl.vars_after if fl is not None else self.global_vars
+            fg_state = fl.fg_after if fl is not None else self.fg_state
+            rng = fl.rng_after if fl is not None else self._snapshot_rng()
+            path = self.folder / "model_last.pt.tar"
+            lr = float(params["lr"])
+            written = [path]
+            ckpt.save_checkpoint(path, model_vars, epoch, lr,
                                  async_save=async_save)
-            written.append(p)
-        # best-val snapshot whenever the global eval loss improves
-        # (helper.py:433-435, called with epoch_loss from main.py:233)
-        if self.last_global_loss < self.best_loss:
-            p = Path(str(path) + ".best")
-            ckpt.save_checkpoint(p, model_vars, epoch, lr,
-                                 async_save=async_save)
-            written.append(p)
-            self.best_loss = self.last_global_loss
-        # full-state sidecar (deviation, documented in checkpoint.py): the
-        # reference loses FoolsGold memory / best loss / RNG position on
-        # restart; we persist them so resume replays the exact trajectory.
-        # Every snapshot gets one — resuming from .epoch_N/.best must not
-        # silently reset the defense. One writer on multi-process.
-        mem = fg_state.memory
-        if jax.process_index() == 0 and (jax.process_count() == 1
-                                         or mem.is_fully_addressable):
-            aux = {"epoch": int(epoch),
-                   "fg_memory": np.asarray(mem),
-                   "best_loss": float(self.best_loss),
-                   "last_backdoor_acc": self.last_backdoor_acc,
-                   **rng}
-            for p in written:
-                ckpt.save_aux_state(p, aux)
+            if epoch in list(params["save_on_epochs"]):
+                p = Path(str(path) + f".epoch_{epoch}")
+                ckpt.save_checkpoint(p, model_vars, epoch, lr,
+                                     async_save=async_save)
+                written.append(p)
+            # best-val snapshot whenever the global eval loss improves
+            # (helper.py:433-435, called with epoch_loss from main.py:233)
+            if self.last_global_loss < self.best_loss:
+                p = Path(str(path) + ".best")
+                ckpt.save_checkpoint(p, model_vars, epoch, lr,
+                                     async_save=async_save)
+                written.append(p)
+                self.best_loss = self.last_global_loss
+            # full-state sidecar (deviation, documented in checkpoint.py):
+            # the reference loses FoolsGold memory / best loss / RNG position
+            # on restart; we persist them so resume replays the exact
+            # trajectory. Every snapshot gets one — resuming from
+            # .epoch_N/.best must not silently reset the defense. One writer
+            # on multi-process.
+            mem = fg_state.memory
+            if jax.process_index() == 0 and (jax.process_count() == 1
+                                             or mem.is_fully_addressable):
+                aux = {"epoch": int(epoch),
+                       "fg_memory": np.asarray(mem),
+                       "best_loss": float(self.best_loss),
+                       "last_backdoor_acc": self.last_backdoor_acc,
+                       **rng}
+                for p in written:
+                    ckpt.save_aux_state(p, aux)
 
     def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
+        try:
+            return self._run_rounds(epochs)
+        finally:
+            # end-of-run telemetry: final trace.json flush + the printed
+            # phase-summary table (p50/p95 per span, recompile count, peak
+            # device memory) — also on a mid-run exception, so a crashed
+            # run still leaves a loadable trace
+            self._finish_telemetry()
+
+    def _finish_telemetry(self) -> None:
+        t = self.telemetry
+        if not t.enabled:
+            return
+        t.record_memory()
+        t.close()
+        print(t.summary_table())
+
+    def _run_rounds(self, epochs: Optional[int] = None) -> Dict[str, Any]:
         last: Dict[str, Any] = {}
         end = epochs if epochs is not None else int(self.params["epochs"])
         profile_dir = str(self.params.get("profile_dir", "") or "")
+        if self.telemetry.enabled and not self.sequential_debug:
+            # compile every dynamic-steps bucket up front: mark_warm() fires
+            # after the first full round, and a later round landing in a
+            # fresh bucket would otherwise count its legitimate first
+            # compile as a retrace regression
+            with self.telemetry.span("engine/warm_buckets"):
+                self.warm_step_buckets()
         # pipeline_rounds: overlap round N's host fetch/record with round
         # N+1's device compute (depth 1). Checkpoints ride orbax async saves
         # — save_model(fl=...) uses the state captured at dispatch, and
         # AsyncCheckpointer serializes commits, so per-epoch checkpoints
-        # land in program order (tests/test_async_checkpoint.py). Only
-        # profiling still forces sequential rounds (a trace needs one
-        # round's dispatch+fetch alone on the timeline).
-        if bool(self.params.get("pipeline_rounds", False)) and not profile_dir:
+        # land in program order (tests/test_async_checkpoint.py). Profiling
+        # forces sequential rounds (a trace needs one round's dispatch+fetch
+        # alone on the timeline), and so does telemetry: finalize(N) flushes
+        # round N's histogram window, which dispatch(N+1) — fully synced on
+        # the split path — would otherwise pollute with round N+1's spans.
+        if (bool(self.params.get("pipeline_rounds", False))
+                and not profile_dir and not self.telemetry.enabled):
             def finalize_and_log(fl):
                 r = self.finalize_round(fl)
                 self.save_model(fl.epoch, fl=fl, async_save=True)
+                # one full round has finished end-to-end: every program a
+                # steady-state round needs has compiled — later compiles
+                # are retrace regressions (telemetry counts + warns)
+                self.telemetry.mark_warm()
                 logger.info("epoch %d done in %.2fs acc=%.2f backdoor=%s",
                             r["epoch"], r["round_time"], r["global_acc"],
                             r["backdoor_acc"])
@@ -1044,6 +1204,7 @@ class Experiment:
             else:
                 last = self.run_round(epoch)
             self.save_model(epoch)
+            self.telemetry.mark_warm()  # first full round ends warmup
             logger.info("epoch %d done in %.2fs acc=%.2f backdoor=%s",
                         epoch, last["round_time"], last["global_acc"],
                         last["backdoor_acc"])

@@ -36,6 +36,7 @@ from dba_mod_tpu.fl.evaluation import EvalResult, make_eval_fn
 from dba_mod_tpu.fl.state import ClientTask, RoundHyper
 from dba_mod_tpu.ops import aggregation as agg
 from dba_mod_tpu.ops.losses import tree_global_norm
+from dba_mod_tpu.utils import telemetry
 
 
 def count_bn_layers(batch_stats: Any) -> int:
@@ -185,6 +186,16 @@ class RoundEngine:
     def __init__(self, params: cfg.Params, model_def: ModelDef,
                  data: DeviceData, plans: EvalPlans, mesh=None,
                  num_segments: int = 1):
+        # one span around the whole host-side build (tracing the jit
+        # wrappers is free — XLA compiles lazily on first call; those
+        # compiles land in the xla/compiles counter via the monitoring
+        # listener, not here)
+        with telemetry.span("engine/build"):
+            self._build(params, model_def, data, plans, mesh, num_segments)
+
+    def _build(self, params: cfg.Params, model_def: ModelDef,
+               data: DeviceData, plans: EvalPlans, mesh,
+               num_segments: int):
         self.params = params
         self.hyper = RoundHyper.from_params(params)
         self.model_def = model_def
@@ -517,6 +528,30 @@ class RoundEngine:
             return r.acc
 
         self.backdoor_acc_fn = jax.jit(backdoor_acc)
+
+        # Standalone batteries get telemetry spans with honest device-sync
+        # points (fl/evaluation.py:instrument_eval) — a passthrough while
+        # telemetry is off, so the fused/pipelined paths keep their deferred
+        # sync. `batches` counts eval-plan scan steps (= batch fetches; the
+        # stacked batteries share one gather across the C client models).
+        from dba_mod_tpu.fl.evaluation import instrument_eval
+        clean_steps = int(plans.clean_idx.shape[0])
+        poison_steps = int(plans.poison_idx.shape[0])
+        local_batches = clean_steps + (3 * poison_steps if is_poison_run
+                                       else 0)
+        global_batches = clean_steps + ((1 + n_triggers) * poison_steps
+                                        if is_poison_run else 0)
+        self.local_evals_fn = instrument_eval(
+            self.local_evals_fn, "eval/local", batches=local_batches)
+        if self.seg_local_evals_fn is not None:
+            self.seg_local_evals_fn = instrument_eval(
+                self.seg_local_evals_fn, "eval/seg_local",
+                batches=(num_segments - 1) * local_batches)
+        self.global_evals_fn = instrument_eval(
+            self.global_evals_fn, "eval/global", batches=global_batches)
+        self.backdoor_acc_fn = instrument_eval(
+            self.backdoor_acc_fn, "eval/backdoor_probe",
+            batches=poison_steps)
 
         # The whole round as ONE program: train → [inject faults → screen] →
         # aggregate → local evals → global evals. One dispatch, no

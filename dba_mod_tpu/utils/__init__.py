@@ -1,5 +1,6 @@
 """Observability: CSV recorder with reference-schema parity, JSONL metrics,
-run-folder logging. Plotting is deliberately decoupled from models (the
-reference's visdom mixin, models/simple.py:18-200, is not carried over —
-SURVEY §7.3)."""
+run-folder logging, and the telemetry layer (span tracing, metrics registry,
+XLA compile/memory instrumentation — utils/telemetry.py). Plotting is
+deliberately decoupled from models (the reference's visdom mixin,
+models/simple.py:18-200, is not carried over — SURVEY §7.3)."""
 from dba_mod_tpu.utils.recorder import Recorder

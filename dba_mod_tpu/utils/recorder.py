@@ -16,8 +16,11 @@ TensorBoard, so each chart family maps to a named TB tag (see PARITY.md):
   poison_triggerweight_vis_acc / poison_state_trigger_acc
                            → trigger_test/acc/{model}.{trigger}, .../loss/...
 
-Like the reference, `save()` rewrites every CSV each round (csv_record.py:21-59
-— crash-safe tail); unlike it, state lives on an instance, not module globals.
+Like the reference, `save()` rewrites every CSV each round
+(csv_record.py:21-59); unlike it, every rewrite is atomic (tempfile in the
+run folder + os.replace, so a crash mid-save can no longer truncate
+metrics.jsonl / round_result.csv) and state lives on an instance, not module
+globals.
 The per-batch channels (train_batch/distance) additionally land in CSVs of
 their own — the reference only plotted them.
 """
@@ -25,6 +28,7 @@ from __future__ import annotations
 
 import csv
 import json
+import os
 import time
 from pathlib import Path
 from typing import Any, List, Optional
@@ -39,10 +43,14 @@ BATCH_HEADER = ["local_model", "round", "epoch", "internal_epoch", "batch",
                 "value"]
 # per-round robustness columns (fl/faults.py + the quarantine pass in
 # fl/rounds.py) so PARITY/trajectory harnesses can plot attack success
-# under faults; all-zero when the fault layer is off
+# under faults; all-zero when the fault layer is off. dispatch_time /
+# finalize_time split round_time into host-planning+enqueue vs the round's
+# blocking fetch (perf_counter durations; under pipeline_rounds round_time
+# spans the overlap with the next round's dispatch — the split columns are
+# the honest per-phase numbers)
 ROUND_HEADER = ["epoch", "global_acc", "global_loss", "backdoor_acc",
                 "n_quarantined", "n_dropped", "n_retries", "degraded",
-                "round_time"]
+                "round_time", "dispatch_time", "finalize_time"]
 
 
 def _tag(name: Any) -> str:
@@ -149,7 +157,9 @@ class Recorder:
                  int(kwargs.get("n_dropped", 0) or 0),
                  int(kwargs.get("n_retries", 0) or 0),
                  int(bool(kwargs.get("degraded", False))),
-                 kwargs.get("round_time")])
+                 kwargs.get("round_time"),
+                 kwargs.get("dispatch_time"),
+                 kwargs.get("finalize_time")])
         if self._tb is not None and "epoch" in kwargs:
             step = int(kwargs["epoch"])
             for k, v in kwargs.items():
@@ -158,6 +168,21 @@ class Recorder:
             self._tb.flush()
 
     # ------------------------------------------------------------------ save
+    def _atomic_write(self, name: str, emit) -> None:
+        """Crash-safe full rewrite: `emit(file)` writes into a tempfile in
+        the run folder, which is `os.replace`d over the target only on
+        success — a crash (or an exception) mid-save leaves the previously
+        saved file intact, where the old rewrite-in-place truncated it."""
+        path = self.folder / name
+        tmp = self.folder / (name + ".tmp")
+        try:
+            with open(tmp, "w", newline="") as f:
+                emit(f)
+            os.replace(tmp, path)
+        finally:
+            if tmp.exists():
+                tmp.unlink()
+
     def save(self, is_poison: bool):
         # the scale row closes at save time whether or not files are written
         # (csv_record.py:44-50 semantics)
@@ -169,11 +194,12 @@ class Recorder:
         self.folder.mkdir(parents=True, exist_ok=True)
 
         def write(name, header, rows):
-            with open(self.folder / name, "w", newline="") as f:
+            def emit(f):
                 w = csv.writer(f)
                 if header:
                     w.writerow(header)
                 w.writerows(rows)
+            self._atomic_write(name, emit)
 
         write("train_result.csv", TRAIN_HEADER, self.train_result)
         write("test_result.csv", TEST_HEADER, self.test_result)
@@ -194,6 +220,8 @@ class Recorder:
                   self.posiontest_result)
             write("poisontriggertest_result.csv", TRIGGER_HEADER,
                   self.poisontriggertest_result)
-        with open(self.folder / "metrics.jsonl", "w") as f:
+
+        def emit_jsonl(f):
             for row in self._jsonl_rows:
                 f.write(json.dumps(row) + "\n")
+        self._atomic_write("metrics.jsonl", emit_jsonl)

@@ -1,0 +1,567 @@
+"""Process-wide telemetry: span tracing, a metrics registry, and XLA
+compile/memory instrumentation for the round path.
+
+Until now the only per-round observability was the recorder's CSV/JSONL
+parity set plus a single wall-clock `round_time` — "where did this round's
+time go, did XLA recompile, and what did the device hold" needed an external
+profiler. This module makes those first-class:
+
+- **Spans** — nestable ``with telemetry.span("round/dispatch"):`` blocks
+  timed with ``time.perf_counter()``. Because JAX dispatch is asynchronous, a
+  span that measures device work must end at an explicit sync point:
+  ``telemetry.sync(payload)`` (``jax.block_until_ready``) inside the block,
+  or :func:`instrument`, which wraps a compiled callable so every call runs
+  under a synced span. Spans export as Chrome-trace-format ``trace.json``
+  (open in Perfetto / ``chrome://tracing``) and feed per-round duration
+  histograms.
+- **Metrics registry** — counters (cumulative), gauges (last value) and
+  histograms (windowed between flushes). :meth:`Telemetry.flush_round`
+  writes one JSON line per round to ``telemetry.jsonl`` and mirrors scalars
+  to the recorder's TensorBoard writer under ``telemetry/...`` tags.
+- **XLA instrumentation** — a ``jax.monitoring`` listener counts every
+  backend compile (jit cache miss that reaches XLA); after
+  :meth:`Telemetry.mark_warm` any further compile increments
+  ``xla/recompiles_after_warmup`` and logs loudly, so silent retrace
+  regressions fail in tests instead of burning device-minutes in
+  production. Per-round device memory gauges come from
+  ``jax.local_devices()[0].memory_stats()`` where the backend provides it
+  (TPU does; CPU returns None and the gauges are simply absent).
+
+The module keeps ONE process-wide current instance (:func:`current`),
+defaulting to a no-op null object: call sites in the round path pay a single
+attribute check when telemetry is off, and the knobs (``telemetry``,
+``telemetry_dir`` in config.py) add no files and no per-round work. These
+files are additive observability, not part of the reference-parity CSV set
+(PARITY.md).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("dba_mod_tpu")
+
+# jax.monitoring event fired on every backend compile — i.e. every jit cache
+# miss that actually reaches XLA (tracing-only cache hits don't fire it).
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# persistent-compile-cache misses (only fired when the disk cache is enabled)
+PERSISTENT_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_LOCK = threading.Lock()
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted list (q in [0, 1])."""
+    if not sorted_vals:
+        return 0.0
+    i = min(round(q * (len(sorted_vals) - 1)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with _LOCK:
+            self.value += int(n)
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Windowed histogram: observations accumulate until the next per-round
+    flush snapshots-and-resets the window; exact all-run count/sum ride
+    along (the end-of-run p50/p95 span summary draws on the per-span
+    durations Telemetry keeps, not on histogram windows)."""
+
+    __slots__ = ("window", "total_count", "total_sum")
+
+    def __init__(self):
+        self.window: List[float] = []
+        self.total_count = 0
+        self.total_sum = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with _LOCK:
+            self.window.append(v)
+            self.total_count += 1
+            self.total_sum += v
+
+    def snapshot_and_reset(self) -> Dict[str, float]:
+        with _LOCK:
+            vals, self.window = self.window, []
+        vals.sort()
+        return {"count": len(vals), "sum": sum(vals),
+                "min": vals[0] if vals else 0.0,
+                "max": vals[-1] if vals else 0.0,
+                "p50": _percentile(vals, 0.50),
+                "p95": _percentile(vals, 0.95)}
+
+
+class _NullMetric:
+    """Shared no-op counter/gauge/histogram for the disabled path."""
+    value = 0
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NULL_METRIC = _NullMetric()
+_NULL_CM = contextlib.nullcontext()  # reusable; nullcontext holds no state
+
+
+class _NullTelemetry:
+    """The disabled telemetry object: every operation is a no-op, `enabled`
+    is the one attribute hot paths check. Shared singleton."""
+    enabled = False
+    current_epoch: Optional[int] = None
+
+    def span(self, name: str):
+        return _NULL_CM
+
+    def sync(self, x: Any) -> Any:
+        return x
+
+    def counter(self, name: str) -> _NullMetric:
+        return _NULL_METRIC
+
+    def gauge(self, name: str) -> _NullMetric:
+        return _NULL_METRIC
+
+    def histogram(self, name: str) -> _NullMetric:
+        return _NULL_METRIC
+
+    def phase(self) -> str:
+        return "-"
+
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        pass
+
+    def mark_warm(self) -> None:
+        pass
+
+    def record_memory(self) -> None:
+        pass
+
+    def flush_round(self, epoch: int) -> None:
+        pass
+
+    def write_trace(self) -> None:
+        pass
+
+    def summary_table(self) -> str:
+        return "telemetry disabled"
+
+    def close(self) -> None:
+        pass
+
+
+NULL = _NullTelemetry()
+
+
+class Telemetry:
+    """One run's telemetry state. Construct via :func:`configure` so call
+    sites throughout the round path resolve it through :func:`current`."""
+
+    enabled = True
+    TRACE_WRITE_EVERY = 20  # flushes between periodic trace.json rewrites
+
+    def __init__(self, folder: Optional[Path] = None,
+                 tb_sink: Optional[Callable[[str, float, int], None]] = None,
+                 max_trace_events: int = 200_000):
+        self.folder = Path(folder) if folder is not None else None
+        self.tb_sink = tb_sink
+        self.max_trace_events = int(max_trace_events)
+        self._origin = time.perf_counter()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._trace_events: List[dict] = []
+        self._span_all: Dict[str, List[float]] = {}
+        self._local = threading.local()
+        self._flush_count = 0
+        self._warm = False
+        self.current_epoch: Optional[int] = None
+        self.peak_memory_bytes = 0
+        if self.folder is not None:
+            self.folder.mkdir(parents=True, exist_ok=True)
+            # truncate a stale jsonl from a previous run in the same folder
+            (self.folder / "telemetry.jsonl").write_text("")
+
+    # ------------------------------------------------------------- registry
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with _LOCK:
+                c = self._counters.setdefault(name, Counter())
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with _LOCK:
+                g = self._gauges.setdefault(name, Gauge())
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with _LOCK:
+                h = self._histograms.setdefault(name, Histogram())
+        return h
+
+    # ---------------------------------------------------------------- spans
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Nestable timed block. End device-measuring spans at a sync point:
+        call :meth:`sync` on the measured payload inside the block."""
+        stack = self._stack()
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            self._record_span(name, t0, dur)
+
+    def sync(self, x: Any) -> Any:
+        """``jax.block_until_ready`` on `x` — the explicit device-sync point
+        that makes a span honest under JAX's async dispatch."""
+        import jax
+        return jax.block_until_ready(x)
+
+    def _record_span(self, name: str, t0: float, dur: float) -> None:
+        event = {"name": name, "ph": "X", "cat": "span",
+                 "ts": (t0 - self._origin) * 1e6, "dur": dur * 1e6,
+                 "pid": os.getpid(), "tid": threading.get_ident()}
+        with _LOCK:
+            if len(self._trace_events) < self.max_trace_events:
+                self._trace_events.append(event)
+                dropped = False
+            else:
+                dropped = True
+            self._span_all.setdefault(name, []).append(dur)
+        if dropped:
+            self.counter("trace/dropped_events").inc()
+        self.histogram(f"span/{name}").observe(dur)
+
+    def phase(self) -> str:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else "-"
+
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        self.current_epoch = epoch
+
+    # ------------------------------------------------------ instrumentation
+    def mark_warm(self) -> None:
+        """Declare warmup over: every program a steady-state round needs has
+        compiled. Any backend compile after this is a retrace regression —
+        counted in ``xla/recompiles_after_warmup`` and logged loudly.
+        Idempotent — only the first call flips the flag."""
+        if self._warm:
+            return
+        self._warm = True
+        # materialize the counter so post-warmup flushes report an explicit
+        # 0 rather than an absent key
+        self.counter("xla/recompiles_after_warmup")
+        logger.info("telemetry: warmup complete after %d XLA compiles; "
+                    "further compiles are counted as recompiles",
+                    self.counter("xla/compiles").value)
+
+    def record_memory(self) -> None:
+        """Device memory gauges from the backend, when it reports them
+        (TPU/GPU do; the CPU backend returns None and this is a no-op)."""
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — absent backend support must
+            stats = None   # never break a round
+        if not stats:
+            return
+        for key in ("bytes_in_use", "peak_bytes_in_use",
+                    "largest_alloc_size", "bytes_limit"):
+            if key in stats:
+                self.gauge(f"memory/{key}").set(stats[key])
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+        self.peak_memory_bytes = max(self.peak_memory_bytes, int(peak))
+
+    # ----------------------------------------------------------- round flush
+    def flush_round(self, epoch: int) -> None:
+        """One JSON line per round: cumulative counters, last-value gauges,
+        and the histogram window since the previous flush (span durations,
+        delta norms). Mirrored to TensorBoard when a sink is wired."""
+        self.record_memory()
+        with _LOCK:
+            counters = {k: c.value for k, c in self._counters.items()}
+            gauges = {k: g.value for k, g in self._gauges.items()
+                      if g.value is not None}
+            hist_items = list(self._histograms.items())
+        hists = {}
+        for k, h in hist_items:
+            snap = h.snapshot_and_reset()
+            if snap["count"]:
+                hists[k] = {m: round(v, 6) for m, v in snap.items()}
+        row = {"epoch": int(epoch), "time": time.time(),
+               "counters": counters, "gauges": gauges, "histograms": hists}
+        if self.folder is not None:
+            with open(self.folder / "telemetry.jsonl", "a") as f:
+                f.write(json.dumps(row) + "\n")
+            # trace.json is a full rewrite (the Chrome trace format is one
+            # JSON document), so a per-round rewrite would make trace I/O
+            # quadratic over a long run — persist on the first flush and
+            # every Kth after; close() always writes the complete trace
+            self._flush_count += 1
+            if self._flush_count % self.TRACE_WRITE_EVERY == 1:
+                self.write_trace()
+        if self.tb_sink is not None:
+            step = int(epoch)
+            for k, v in counters.items():
+                self.tb_sink(f"telemetry/{k}", float(v), step)
+            for k, v in gauges.items():
+                self.tb_sink(f"telemetry/{k}", float(v), step)
+            for k, snap in hists.items():
+                self.tb_sink(f"telemetry/{k}/p50", snap["p50"], step)
+                self.tb_sink(f"telemetry/{k}/p95", snap["p95"], step)
+
+    # ----------------------------------------------------------- trace file
+    def write_trace(self) -> None:
+        """Atomic rewrite of ``trace.json`` (Chrome trace format). Called
+        periodically from :meth:`flush_round` and always from :meth:`close`,
+        so a crashed run still leaves a loadable (if slightly stale)
+        trace."""
+        if self.folder is None:
+            return
+        with _LOCK:
+            events = list(self._trace_events)
+        meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+                 "args": {"name": "dba_mod_tpu"}}]
+        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        path = self.folder / "trace.json"
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(doc))
+        os.replace(tmp, path)
+
+    # -------------------------------------------------------------- summary
+    def summary_table(self) -> str:
+        """End-of-run phase summary: p50/p95 per span, recompile count, peak
+        device memory."""
+        with _LOCK:
+            spans = {k: sorted(v) for k, v in self._span_all.items()}
+        lines = [f"{'span':<32} {'count':>6} {'total_s':>9} "
+                 f"{'p50_ms':>9} {'p95_ms':>9}"]
+        for name in sorted(spans):
+            vals = spans[name]
+            lines.append(
+                f"{name:<32} {len(vals):>6} {sum(vals):>9.3f} "
+                f"{_percentile(vals, 0.50) * 1e3:>9.2f} "
+                f"{_percentile(vals, 0.95) * 1e3:>9.2f}")
+        compiles = self.counter("xla/compiles").value
+        recompiles = self.counter("xla/recompiles_after_warmup").value
+        mem = (f"{self.peak_memory_bytes / 2**20:.1f} MiB"
+               if self.peak_memory_bytes else "n/a")
+        lines.append(f"xla compiles: {compiles} "
+                     f"(after warmup: {recompiles}) | "
+                     f"peak device memory: {mem}")
+        return "\n".join(lines)
+
+    def close(self) -> None:
+        """Final trace/summary flush; safe to call more than once."""
+        if self.folder is not None:
+            self.write_trace()
+
+
+# --------------------------------------------------------- process-wide state
+_current: Any = NULL
+_listeners_installed = False
+
+
+def current() -> Any:
+    """The process-wide telemetry instance (the null object when off)."""
+    return _current
+
+
+def configure(enabled: bool, folder: Optional[Path] = None,
+              tb_sink: Optional[Callable[[str, float, int], None]] = None,
+              ) -> Any:
+    """Install (or clear) the process-wide telemetry instance. With
+    `enabled` False the null object is installed and no files are touched.
+    One instance per process: a second Experiment in the same process takes
+    over the module-level current, so spans from SHARED code paths
+    (checkpoint.py, rounds.py eval wrappers) follow the most recent
+    experiment — an Experiment's own round spans go through its
+    `self.telemetry` handle and are unaffected by the takeover."""
+    global _current
+    if not enabled:
+        _current = NULL
+        return NULL
+    _current = Telemetry(folder=folder, tb_sink=tb_sink)
+    install_xla_listeners()
+    return _current
+
+
+def span(name: str):
+    return _current.span(name)
+
+
+def sync(x: Any) -> Any:
+    if _current.enabled:
+        _current.sync(x)
+    return x
+
+
+def count(name: str, n: int = 1) -> None:
+    if _current.enabled:
+        _current.counter(name).inc(n)
+
+
+def observe(name: str, v: float) -> None:
+    if _current.enabled:
+        _current.histogram(name).observe(v)
+
+
+def set_gauge(name: str, v: float) -> None:
+    if _current.enabled:
+        _current.gauge(name).set(v)
+
+
+def set_epoch(epoch: Optional[int]) -> None:
+    _current.set_epoch(epoch)
+
+
+def instrument(fn: Callable, name: str, batches: int = 0) -> Callable:
+    """Wrap a compiled callable so every call runs under a synced span
+    (`jax.block_until_ready` on the result — honest device time under async
+    dispatch). Zero-overhead passthrough while telemetry is off; `batches`
+    increments the ``eval/batches`` counter per call when set."""
+    def wrapped(*args, **kwargs):
+        t = _current
+        if not t.enabled:
+            return fn(*args, **kwargs)
+        with t.span(name):
+            out = fn(*args, **kwargs)
+            t.sync(out)
+        if batches:
+            t.counter("eval/batches").inc(batches)
+        return out
+    wrapped.__wrapped__ = fn
+    wrapped.__name__ = getattr(fn, "__name__", name)
+    return wrapped
+
+
+# ------------------------------------------------------------- XLA listeners
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    t = _current
+    if not t.enabled or event != BACKEND_COMPILE_EVENT:
+        return
+    t.counter("xla/compiles").inc()
+    t.histogram("xla/compile_secs").observe(duration)
+    if t._warm:
+        t.counter("xla/recompiles_after_warmup").inc()
+        logger.warning(
+            "telemetry: XLA backend compile AFTER warmup (%.2fs) — a shape "
+            "or constant is retracing in the steady state", duration)
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if _current.enabled and event == PERSISTENT_CACHE_MISS_EVENT:
+        _current.counter("xla/persistent_cache_misses").inc()
+
+
+def install_xla_listeners() -> None:
+    """Register the jax.monitoring listeners once per process. The listeners
+    forward to whatever instance is current, so they are safe to leave
+    installed when telemetry is later disabled."""
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    import jax
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    jax.monitoring.register_event_listener(_on_event)
+    _listeners_installed = True
+
+
+# -------------------------------------------------------------- logging setup
+class _PhaseFilter(logging.Filter):
+    """Injects epoch/phase context (the current telemetry span) into every
+    record so the formatter can show where in the round a line came from."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        t = _current
+        ep = t.current_epoch
+        record.phase = (f"e{ep}/{t.phase()}" if ep is not None
+                        else t.phase())
+        return True
+
+
+_LOG_FORMAT = "%(asctime)s %(levelname).1s [%(phase)s] %(message)s"
+
+
+def setup_logging(folder: Optional[Path] = None,
+                  level: int = logging.INFO) -> logging.Logger:
+    """Idempotent configuration of the ``dba_mod_tpu`` logger.
+
+    Replaces the previous per-Experiment ``logging.basicConfig`` + stacked
+    ``FileHandler`` (two experiments in one process — e.g. a parity A/B —
+    each added a handler and every line went to both files, duplicated).
+    The stream handler and formatter are configured exactly once; the
+    run-folder file handler is REPLACED when a new folder is configured, so
+    log lines follow the active experiment. With no `folder` the logger is
+    returned untouched — folder-less runs (bench.py, ``--no-save``) stay as
+    quiet as they were before this helper existed."""
+    lg = logging.getLogger("dba_mod_tpu")
+    if folder is None:
+        return lg
+    fmt = logging.Formatter(_LOG_FORMAT)
+    if not getattr(lg, "_dba_configured", False):
+        lg.setLevel(level)
+        sh = logging.StreamHandler()
+        sh.setFormatter(fmt)
+        sh.addFilter(_PhaseFilter())
+        lg.addHandler(sh)
+        lg.propagate = False
+        lg._dba_configured = True  # type: ignore[attr-defined]
+    path = os.path.abspath(str(Path(folder) / "log.txt"))
+    existing = [h for h in lg.handlers
+                if getattr(h, "_dba_run_file", False)]
+    if any(getattr(h, "baseFilename", None) == path for h in existing):
+        return lg
+    for h in existing:
+        lg.removeHandler(h)
+        h.close()
+    fh = logging.FileHandler(path)
+    fh.setFormatter(fmt)
+    fh.addFilter(_PhaseFilter())
+    fh._dba_run_file = True  # type: ignore[attr-defined]
+    lg.addHandler(fh)
+    return lg

@@ -1,0 +1,319 @@
+"""Telemetry subsystem (utils/telemetry.py) + the recorder's crash-safe
+saves.
+
+Covers: span nesting and timing monotonicity, Chrome-trace JSON schema,
+counter/gauge/histogram flush semantics (cumulative counters, windowed
+histograms), the XLA recompile listener (fires on a forced retrace, silent
+on a cache hit), no-op mode adding no files, idempotent logging setup, the
+recorder's atomic save (a failure mid-write leaves the previous file
+intact), and the end-to-end Experiment wiring — telemetry files with the
+required per-round spans, and none at all when the knob is off.
+"""
+import csv
+import json
+import logging
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.experiment import Experiment
+from dba_mod_tpu.utils import telemetry as tel
+from dba_mod_tpu.utils.recorder import ROUND_HEADER, Recorder
+
+SMOKE = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=2, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=True, random_seed=1)
+
+
+@pytest.fixture
+def enabled_tel(tmp_path):
+    t = tel.configure(enabled=True, folder=tmp_path)
+    yield t
+    tel.configure(enabled=False)
+
+
+# ------------------------------------------------------------------- spans
+def test_span_nesting_and_timing_monotonicity(enabled_tel):
+    with tel.span("outer"):
+        time.sleep(0.01)
+        with tel.span("inner"):
+            time.sleep(0.01)
+    events = {e["name"]: e for e in enabled_tel._trace_events}
+    outer, inner = events["outer"], events["inner"]
+    assert inner["dur"] > 0 and outer["dur"] >= inner["dur"]
+    # containment: the inner span starts no earlier and ends no later
+    assert inner["ts"] >= outer["ts"]
+    assert (inner["ts"] + inner["dur"]
+            <= outer["ts"] + outer["dur"] + 1.0)  # 1 µs slack
+    # spans feed duration histograms
+    assert enabled_tel.histogram("span/outer").total_count == 1
+    assert enabled_tel.histogram("span/inner").total_count == 1
+
+
+def test_span_stack_feeds_phase_context(enabled_tel):
+    assert enabled_tel.phase() == "-"
+    with tel.span("round/dispatch"):
+        assert enabled_tel.phase() == "round/dispatch"
+        with tel.span("eval/global"):
+            assert enabled_tel.phase() == "eval/global"
+        assert enabled_tel.phase() == "round/dispatch"
+    assert enabled_tel.phase() == "-"
+
+
+def test_chrome_trace_schema(enabled_tel, tmp_path):
+    with tel.span("a"):
+        with tel.span("b"):
+            pass
+    enabled_tel.write_trace()
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    assert isinstance(doc["traceEvents"], list)
+    complete = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in complete} == {"a", "b"}
+    for e in complete:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert key in e
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    # metadata record present (process naming for Perfetto)
+    assert any(e.get("ph") == "M" for e in doc["traceEvents"])
+
+
+def test_sync_returns_payload(enabled_tel):
+    x = jnp.ones((3,)) * 2
+    assert tel.sync(x) is x
+    np.testing.assert_array_equal(np.asarray(x), 2.0)
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_histogram_flush_and_window_reset(enabled_tel, tmp_path):
+    enabled_tel.counter("rounds").inc()
+    enabled_tel.counter("rounds").inc(2)
+    enabled_tel.histogram("delta_norm").observe(1.0)
+    enabled_tel.histogram("delta_norm").observe(3.0)
+    enabled_tel.gauge("g").set(7.0)
+    enabled_tel.flush_round(1)
+    enabled_tel.flush_round(2)
+    lines = [json.loads(line) for line in
+             (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    assert [ln["epoch"] for ln in lines] == [1, 2]
+    assert lines[0]["counters"]["rounds"] == 3
+    h = lines[0]["histograms"]["delta_norm"]
+    assert h["count"] == 2 and h["min"] == 1.0 and h["max"] == 3.0
+    assert h["p95"] == 3.0 and h["sum"] == 4.0
+    assert lines[0]["gauges"]["g"] == 7.0
+    # histograms are windowed per flush; counters are cumulative
+    assert "delta_norm" not in lines[1]["histograms"]
+    assert lines[1]["counters"]["rounds"] == 3
+
+
+# ----------------------------------------------------------- XLA listeners
+def test_recompile_listener_fires_on_retrace_not_cache_hit(enabled_tel):
+    salt = np.float32(time.time() % 97)  # defeat any persistent jit reuse
+
+    @jax.jit
+    def f(x):
+        return x * 2.0 + salt
+
+    f(jnp.ones((4,)))  # warmup compile
+    assert enabled_tel.counter("xla/compiles").value >= 1
+    enabled_tel.mark_warm()
+    f(jnp.ones((4,)))  # jit cache hit: must stay silent
+    assert enabled_tel.counter("xla/recompiles_after_warmup").value == 0
+    f(jnp.ones((5,)))  # new shape: forced retrace, counted loudly
+    assert enabled_tel.counter("xla/recompiles_after_warmup").value >= 1
+
+
+def test_mark_warm_is_idempotent(enabled_tel):
+    enabled_tel.mark_warm()
+    enabled_tel.mark_warm()
+    assert enabled_tel._warm
+    assert enabled_tel.counter("xla/recompiles_after_warmup").value == 0
+
+
+def test_record_memory_never_raises(enabled_tel):
+    enabled_tel.record_memory()  # CPU backend reports None → no-op
+
+
+# ------------------------------------------------------------- no-op mode
+def test_noop_mode_adds_no_files_and_no_state(tmp_path):
+    t = tel.configure(enabled=False, folder=tmp_path)
+    assert t is tel.NULL and not t.enabled
+    with tel.span("x"):
+        pass
+    tel.count("c")
+    tel.observe("h", 1.0)
+    tel.set_gauge("g", 2.0)
+    tel.sync(jnp.ones((2,)))
+    t.flush_round(1)
+    t.write_trace()
+    t.close()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_instrument_is_passthrough_when_disabled(enabled_tel):
+    calls = []
+
+    def f(x):
+        calls.append(x)
+        return x + 1
+
+    tel.configure(enabled=False)
+    g = tel.instrument(f, "probe", batches=5)
+    assert g(1) == 2
+    t2 = tel.configure(enabled=True)
+    assert g(2) == 3
+    assert calls == [1, 2]
+    assert t2.counter("eval/batches").value == 5
+    assert t2.histogram("span/probe").total_count == 1
+    tel.configure(enabled=False)
+
+
+# ------------------------------------------------------------ logging setup
+def test_logging_setup_is_idempotent_and_replaces_run_file(tmp_path):
+    lg = tel.setup_logging(tmp_path)
+    n = len(lg.handlers)
+    assert tel.setup_logging(tmp_path) is lg
+    assert len(lg.handlers) == n  # same folder: nothing added
+    other = tmp_path / "other"
+    other.mkdir()
+    tel.setup_logging(other)
+    run_files = [h for h in lg.handlers
+                 if getattr(h, "_dba_run_file", False)]
+    assert len(run_files) == 1  # replaced, not stacked
+    assert run_files[0].baseFilename.endswith(str(other / "log.txt"))
+    assert lg.propagate is False
+
+
+# --------------------------------------------------- recorder atomic saves
+def test_recorder_atomic_save_keeps_previous_csv_on_failure(tmp_path):
+    rec = Recorder(tmp_path)
+    rec.add_test("global", 1, 0.5, 90.0, 9, 10)
+    rec.add_round_json(epoch=1, global_acc=90.0, round_time=0.1,
+                       dispatch_time=0.08, finalize_time=0.02)
+    rec.save(is_poison=False)
+    before_csv = (tmp_path / "round_result.csv").read_text()
+    before_jsonl = (tmp_path / "metrics.jsonl").read_text()
+
+    class Poison:
+        def __str__(self):
+            raise RuntimeError("boom mid-write")
+
+    rec.round_result.append([Poison()])
+    with pytest.raises(RuntimeError):
+        rec.save(is_poison=False)
+    # the interrupted rewrite left the previous files byte-identical
+    assert (tmp_path / "round_result.csv").read_text() == before_csv
+    assert (tmp_path / "metrics.jsonl").read_text() == before_jsonl
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_recorder_atomic_save_keeps_previous_jsonl_on_failure(tmp_path):
+    rec = Recorder(tmp_path)
+    rec.add_round_json(epoch=1, global_acc=1.0)
+    rec.save(is_poison=False)
+    before = (tmp_path / "metrics.jsonl").read_text()
+    rec._jsonl_rows.append({"bad": object()})  # not JSON-serializable
+    with pytest.raises(TypeError):
+        rec.save(is_poison=False)
+    assert (tmp_path / "metrics.jsonl").read_text() == before
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_round_header_carries_split_times():
+    assert ROUND_HEADER[-3:] == ["round_time", "dispatch_time",
+                                 "finalize_time"]
+
+
+# ------------------------------------------------------------- end-to-end
+def test_experiment_telemetry_end_to_end(tmp_path):
+    e = Experiment(Params.from_dict(dict(
+        SMOKE, telemetry=True, run_dir=str(tmp_path))))
+    try:
+        e.run()
+        folder = e.folder
+        assert (folder / "telemetry.jsonl").exists()
+        assert (folder / "trace.json").exists()
+        doc = json.loads((folder / "trace.json").read_text())
+        names = {ev["name"] for ev in doc["traceEvents"]
+                 if ev.get("ph") == "X"}
+        assert {"round/dispatch", "round/finalize", "round/train",
+                "round/aggregate", "eval/local", "eval/global"} <= names
+        lines = [json.loads(line) for line in
+                 (folder / "telemetry.jsonl").read_text().splitlines()]
+        assert [ln["epoch"] for ln in lines] == [1, 2]
+        last = lines[-1]
+        # per-round span durations for dispatch/finalize/eval
+        for span in ("span/round/dispatch", "span/round/finalize",
+                     "span/eval/global"):
+            assert last["histograms"][span]["count"] >= 1
+        assert last["counters"]["rounds"] == 2
+        assert last["counters"]["eval/batches"] > 0
+        # no retraces once the first full round has compiled everything
+        assert last["counters"]["xla/recompiles_after_warmup"] == 0
+        # the recorder carries the honest split times
+        with open(folder / "round_result.csv") as f:
+            rows = list(csv.reader(f))
+        assert rows[0] == ROUND_HEADER
+        times = dict(zip(rows[0], rows[1]))
+        assert float(times["dispatch_time"]) > 0
+        assert float(times["finalize_time"]) > 0
+        summary = e.telemetry.summary_table()
+        assert "round/dispatch" in summary and "xla compiles" in summary
+    finally:
+        tel.configure(enabled=False)
+
+
+def test_experiment_telemetry_off_writes_no_files(tmp_path):
+    e = Experiment(Params.from_dict(dict(SMOKE, run_dir=str(tmp_path))))
+    e.run_round(1)
+    assert not (e.folder / "telemetry.jsonl").exists()
+    assert not (e.folder / "trace.json").exists()
+    assert e.telemetry is tel.NULL
+
+
+def test_split_path_falls_back_after_takeover(tmp_path):
+    """A later configure() (another Experiment taking over the process-wide
+    instance) must not leave the first experiment paying the split path's
+    per-phase syncs with no spans recorded — it falls back to the fused
+    program while still flushing per-round metrics on its own instance."""
+    e = Experiment(Params.from_dict(dict(
+        SMOKE, telemetry=True, telemetry_dir=str(tmp_path / "t"))),
+        save_results=False)
+    try:
+        assert e._telemetry_split
+        tel.configure(enabled=False)  # a second experiment takes over
+        assert not e._telemetry_split  # → fused dispatch from here on
+        r = e.run_round(1)
+        assert r["dispatch_time"] > 0
+        lines = [json.loads(line) for line in
+                 (tmp_path / "t" / "telemetry.jsonl").read_text()
+                 .splitlines()]
+        assert lines and lines[-1]["counters"]["rounds"] == 1
+    finally:
+        tel.configure(enabled=False)
+
+
+def test_telemetry_split_path_matches_fused_metrics(tmp_path):
+    """telemetry=true routes rounds through the split-phase programs (the
+    same computations the fused round runs, as separate jits); the recorded
+    round metrics must agree with the fused path's."""
+    r_fused = Experiment(Params.from_dict(dict(SMOKE)),
+                         save_results=False).run_round(1)
+    e = Experiment(Params.from_dict(dict(
+        SMOKE, telemetry=True, telemetry_dir=str(tmp_path / "t"))),
+        save_results=False)
+    try:
+        r_split = e.run_round(1)
+        assert r_split["agents"] == r_fused["agents"]
+        np.testing.assert_allclose(r_split["global_acc"],
+                                   r_fused["global_acc"], rtol=1e-5)
+    finally:
+        tel.configure(enabled=False)
