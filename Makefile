@@ -2,7 +2,7 @@
 # command: the fast CPU suite (slow-marked rehearsals deselected) on the
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
-.PHONY: tier1 test-slow trace
+.PHONY: tier1 test-slow trace crash-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -25,3 +25,10 @@ trace:
 	  --params configs/trace_params.yaml
 	@echo "telemetry files:"; ls -1 runs/mnist_*/telemetry.jsonl \
 	  runs/mnist_*/trace.json 2>/dev/null | tail -2
+
+# Preemption drill (README "Crash & preemption tolerance"): tiny run,
+# SIGTERM it mid-flight (expects the graceful-stop exit code 75 + a
+# verified checkpoint), `--resume auto`, assert the run completes in the
+# same folder with no duplicate rounds.
+crash-smoke:
+	bash scripts/crash_smoke.sh

@@ -21,12 +21,36 @@ Two deliberate improvements over the reference:
   the defense. The sidecar carries FoolsGold memory, best-val loss, the
   host RNG streams and the JAX key, so a resumed run replays the
   uninterrupted trajectory exactly (tests/test_full_state_resume.py).
+- **Integrity manifests + auto-resume** (this PR, README "Crash &
+  preemption tolerance"): every committed snapshot gets a
+  ``<name>.manifest.json`` — sha256/size over every file in the orbax step
+  dir plus the aux sidecar, written atomically *after* the commit — so
+  resume verifies before restoring. A corrupt/partial snapshot (a kill -9
+  mid-overwrite, a flipped byte) is detected, quarantined to
+  ``<name>.corrupt/`` and resume falls back to the newest *verified*
+  snapshot instead of crashing or silently restoring garbage.
+  :func:`find_auto_resume` implements ``resumed_model: auto``: discover
+  the newest verified checkpoint across the run folders of a ``run_dir``.
+  :class:`CheckpointManager` adds retention GC (``keep_last_n``; the
+  ``.best`` and ``model_last`` snapshots are always retained) and the
+  startup sweep of orphaned ``*.tmp`` files / uncommitted orbax tmp dirs.
+  For async saves the manifest is deferred until the commit is known to
+  have landed (orbax serializes commits: enqueueing save K proves saves
+  < K are on disk) and always flushed by :func:`wait_for_async_saves`,
+  which is also registered via ``atexit`` so no exit path can lose an
+  in-flight commit.
 """
 from __future__ import annotations
 
+import atexit
+import hashlib
+import json
+import logging
+import os
 import pickle
+import shutil
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -34,9 +58,22 @@ import numpy as np
 from dba_mod_tpu.models import ModelVars
 from dba_mod_tpu.utils import telemetry
 
+logger = logging.getLogger("dba_mod_tpu")
+
 AUX_SUFFIX = ".aux.pkl"
+MANIFEST_SUFFIX = ".manifest.json"
+CORRUPT_SUFFIX = ".corrupt"
+PREV_SUFFIX = ".prev"
+# orbax's uncommitted-checkpoint tmp dirs (atomicity discipline: write to
+# tmp, rename on commit) — a crash mid-commit leaves one behind
+ORBAX_TMP_GLOB = "*.orbax-checkpoint-tmp-*"
 
 _async_ckptr = None
+
+# manifests owed to async saves whose commits have not provably landed yet:
+# abs path -> epoch. Module-level (not per-CheckpointManager) so the atexit
+# flush below covers every manager in the process.
+_pending_manifests: Dict[str, int] = {}
 
 
 def _get_async_checkpointer():
@@ -44,15 +81,25 @@ def _get_async_checkpointer():
     if _async_ckptr is None:
         import orbax.checkpoint as ocp
         _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        # every exit path must land the in-flight commit AND its manifest —
+        # an exception after an async enqueue (or a plain sys.exit) would
+        # otherwise lose the newest checkpoint entirely, since force=True
+        # already deleted the previous model_last
+        atexit.register(wait_for_async_saves)
     return _async_ckptr
 
 
 def wait_for_async_saves() -> None:
-    """Block until every in-flight async checkpoint commit has landed."""
+    """Block until every in-flight async checkpoint commit has landed, then
+    write the manifests those commits were owed. Registered with atexit on
+    first async use, so it runs on every exit path."""
     if _async_ckptr is not None:
         with telemetry.span("checkpoint/wait_async"):
             _async_ckptr.wait_until_finished()
+            # errors first: a failed commit must NOT get a manifest (the
+            # manifest would bless whatever partial files are on disk)
             _async_ckptr.check_for_errors()
+    flush_queued_manifests()
 
 
 def save_checkpoint(path: str | Path, model_vars: ModelVars, epoch: int,
@@ -118,9 +165,457 @@ def save_aux_state(path: str | Path, aux: Dict[str, Any]) -> None:
 def load_aux_state(path: str | Path) -> Optional[Dict[str, Any]]:
     """Read the sidecar written by `save_aux_state`; None when absent
     (e.g. resuming a pretrain-only checkpoint — model-only resume is the
-    reference behavior and stays fully supported)."""
+    reference behavior and stays fully supported). A truncated/corrupt
+    sidecar also degrades to None with a loud warning — model-only resume
+    is the documented fallback (the same one the epoch-mismatch check in
+    Experiment uses), never a crash at restore time."""
     path = Path(str(path) + AUX_SUFFIX).absolute()
     if not path.exists():
         return None
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception as exc:  # noqa: BLE001 — any unpickling failure
+        # (truncation, flipped bytes, EOF) means the sidecar is gone; the
+        # model checkpoint may still be fine
+        telemetry.count("checkpoint/corrupt_detected")
+        logger.warning(
+            "resume sidecar %s is corrupt (%r) — degrading to model-only "
+            "resume (FoolsGold memory and RNG streams restart)", path, exc)
+        return None
+
+
+# ------------------------------------------------------- integrity manifests
+def manifest_path(path: str | Path) -> Path:
+    return Path(str(path) + MANIFEST_SUFFIX).absolute()
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
     with open(path, "rb") as f:
-        return pickle.load(f)
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _checkpoint_files(path: Path) -> Dict[str, Path]:
+    """Every file a manifest covers: the orbax step dir's files (keyed by
+    relative posix path under ``ckpt/``) plus the aux sidecar when
+    present."""
+    out: Dict[str, Path] = {}
+    base = Path(path).absolute()
+    if base.is_dir():
+        for p in sorted(base.rglob("*")):
+            if p.is_file():
+                out["ckpt/" + p.relative_to(base).as_posix()] = p
+    aux = Path(str(base) + AUX_SUFFIX)
+    if aux.exists():
+        out["aux"] = aux
+    return out
+
+
+def write_manifest(path: str | Path, epoch: int) -> Path:
+    """Content-checksum manifest over a *committed* snapshot (orbax step
+    dir + sidecar), written atomically (tmp + os.replace) so a crash
+    mid-write leaves either the previous manifest or none — never a
+    half-manifest that would mark a good checkpoint corrupt."""
+    path = Path(path).absolute()
+    with telemetry.span("checkpoint/manifest"):
+        files = {key: {"sha256": _sha256(p), "size": p.stat().st_size}
+                 for key, p in _checkpoint_files(path).items()}
+        doc = {"version": 1, "epoch": int(epoch), "files": files}
+        mpath = manifest_path(path)
+        tmp = mpath.with_name(mpath.name + ".tmp")
+        tmp.write_text(json.dumps(doc, indent=0, sort_keys=True))
+        os.replace(tmp, mpath)
+    return mpath
+
+
+def manifest_epoch(path: str | Path) -> Optional[int]:
+    """The epoch a snapshot's manifest records; None when there is no
+    (readable) manifest. Cheap — used to order discovery candidates before
+    paying for full verification."""
+    try:
+        return int(json.loads(manifest_path(path).read_text())["epoch"])
+    except (OSError, ValueError, KeyError, TypeError,
+            json.JSONDecodeError):
+        # TypeError: wrong-shape-but-valid JSON (null, a list, epoch:
+        # null) — corruption must demote the candidate, not crash
+        # discovery
+        return None
+
+
+VERIFY_OK = "verified"
+VERIFY_NO_MANIFEST = "no-manifest"
+
+
+def verify_checkpoint(path: str | Path) -> Tuple[bool, str]:
+    """Recompute checksums against the manifest. Returns ``(True,
+    'verified')``, ``(False, 'no-manifest')`` for legacy snapshots (e.g.
+    pretrain outputs saved before manifests existed — callers decide
+    whether to trust them), or ``(False, <reason>)`` for a detected
+    corruption (missing/resized/flipped file, unreadable manifest,
+    missing step dir). Extra files beyond the manifest are ignored."""
+    path = Path(path).absolute()
+    mpath = manifest_path(path)
+    if not mpath.exists():
+        return False, VERIFY_NO_MANIFEST
+    with telemetry.span("checkpoint/verify"):
+        # broad catches: this is the never-crash contract — an unreadable
+        # manifest (EIO on the same failing disk that corrupted the
+        # checkpoint), valid JSON of the wrong shape, or a file vanishing
+        # mid-hash all mean "not verified", never an exception into the
+        # resume path
+        try:
+            doc = json.loads(mpath.read_text())
+            manifest_files = dict(doc["files"])
+        except Exception as exc:  # noqa: BLE001
+            return False, f"unreadable manifest: {exc!r}"
+        if not path.is_dir():
+            return False, "checkpoint dir missing"
+        on_disk = _checkpoint_files(path)
+        try:
+            for key, want in manifest_files.items():
+                p = on_disk.get(key)
+                if p is None:
+                    return False, f"missing file: {key}"
+                if p.stat().st_size != int(want["size"]):
+                    return False, (f"size mismatch: {key} "
+                                   f"({p.stat().st_size} != {want['size']})")
+                if _sha256(p) != want["sha256"]:
+                    return False, f"checksum mismatch: {key}"
+        except Exception as exc:  # noqa: BLE001
+            return False, f"verification error: {exc!r}"
+    return True, VERIFY_OK
+
+
+def quarantine_checkpoint(path: str | Path) -> Path:
+    """Move a corrupt snapshot (step dir + sidecar + manifest) aside to
+    ``<name>.corrupt/`` so it can't be picked again and a human can
+    inspect it. Returns the quarantine dir."""
+    path = Path(path).absolute()
+    dest = Path(str(path) + CORRUPT_SUFFIX)
+    n = 0
+    while dest.exists():
+        n += 1
+        dest = Path(str(path) + f"{CORRUPT_SUFFIX}-{n}")
+    dest.mkdir(parents=True)
+    for piece in (path, Path(str(path) + AUX_SUFFIX), manifest_path(path)):
+        if piece.exists():
+            shutil.move(str(piece), str(dest / piece.name))
+    telemetry.count("checkpoint/quarantined")
+    logger.warning("quarantined corrupt checkpoint %s -> %s", path, dest)
+    return dest
+
+
+# ----------------------------------------------------- discovery / fallback
+def _discovery_candidates(folder: Path) -> List[Tuple[int, float, Path]]:
+    """Manifested snapshot dirs under `folder`, newest first by (manifest
+    epoch, mtime). Only manifested snapshots are candidates: auto-resume
+    restores exclusively from checkpoints it can verify."""
+    out = []
+    if not folder.is_dir():
+        return out
+    for p in folder.iterdir():
+        if not p.is_dir() or CORRUPT_SUFFIX in p.name:
+            continue
+        if "orbax-checkpoint-tmp" in p.name:
+            continue
+        ep = manifest_epoch(p)
+        if ep is None:
+            continue
+        out.append((ep, p.stat().st_mtime, p))
+    # newest epoch first; at equal epoch prefer the canonical snapshot
+    # (model_last / .epoch_N) over .best — identical state, but the
+    # canonical one is what operators expect resume logs to name
+    out.sort(key=lambda t: (t[0], not t[2].name.endswith(".best"), t[1]),
+             reverse=True)
+    return out
+
+
+def latest_verified_checkpoint(folder: str | Path,
+                               quarantine: bool = True) -> Optional[Path]:
+    """Newest snapshot in `folder` that passes manifest verification.
+    Corrupt candidates encountered on the way are counted, logged, and
+    (by default) quarantined — resume *falls back* past them instead of
+    crashing."""
+    folder = Path(folder).absolute()
+    for ep, _, p in _discovery_candidates(folder):
+        ok, reason = verify_checkpoint(p)
+        if ok:
+            return p
+        telemetry.count("checkpoint/corrupt_detected")
+        logger.warning(
+            "checkpoint %s (epoch %d) failed verification: %s — "
+            "falling back to the previous verified snapshot", p, ep, reason)
+        if quarantine:
+            quarantine_checkpoint(p)
+    return None
+
+
+def resolve_verified(path: str | Path) -> Path:
+    """Verification gate for an *explicitly named* resume checkpoint.
+    Verified → the path itself. Manifest-less (legacy/pretrain) → the path,
+    with a debug note — those snapshots predate manifests and stay fully
+    supported. Corrupt → fall back to the newest verified snapshot of the
+    SAME name family (``<name>.prev``/``.epoch_N``/``.best``); with none,
+    raise. The named path may live in a shared checkpoint library that
+    other processes are actively writing, so unlike the auto-resume scan
+    of an exclusively-owned run folder this NEVER mutates the directory —
+    no quarantine, no sweep — and never silently substitutes an
+    unrelated-name checkpoint (which could be a different workload's)."""
+    path = Path(path).absolute()
+    ok, reason = verify_checkpoint(path)
+    if ok:
+        return path
+    if reason == VERIFY_NO_MANIFEST:
+        if not path.is_dir():
+            raise FileNotFoundError(f"resume checkpoint not found: {path}")
+        logger.debug("resume checkpoint %s has no integrity manifest "
+                     "(pre-manifest snapshot) — restoring unverified", path)
+        return path
+    telemetry.count("checkpoint/corrupt_detected")
+    logger.warning("resume checkpoint %s failed verification: %s",
+                   path, reason)
+    for ep, _, p in _discovery_candidates(path.parent):
+        # "." after the base name: family suffixes only (.prev/.epoch_N/
+        # .best) — a bare prefix match would accept an unrelated
+        # "mnist_pretrain_v2" as fallback for "mnist_pretrain"
+        if p == path or not p.name.startswith(path.name + "."):
+            continue
+        if verify_checkpoint(p)[0]:
+            logger.warning("resuming from fallback checkpoint %s "
+                           "(epoch %d)", p, ep)
+            return p
+    raise RuntimeError(
+        f"resume checkpoint {path} is corrupt ({reason}) and no verified "
+        f"same-name fallback ({path.name}.prev/.epoch_N/.best) exists in "
+        f"{path.parent}")
+
+
+def find_auto_resume(run_dir: str | Path,
+                     run_type: str) -> Optional[Tuple[Path, Path]]:
+    """``resumed_model: auto``: scan `run_dir` for this workload's run
+    folders (``{type}_*``), newest first, and return ``(run_folder,
+    checkpoint_path)`` for the newest verified checkpoint — or None when
+    no run folder holds one (fresh start)."""
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return None
+    folders = sorted((p for p in run_dir.glob(f"{run_type}_*")
+                      if p.is_dir()),
+                     key=lambda p: p.stat().st_mtime, reverse=True)
+    for folder in folders:
+        hit = latest_verified_checkpoint(folder)
+        if hit is not None:
+            return folder, hit
+    return None
+
+
+# ------------------------------------------------------ fallback protection
+def _clone_file(src: Path, dst: Path) -> None:
+    try:
+        os.link(src, dst)  # same dir => same fs; shares data blocks
+    except OSError:  # pragma: no cover — fs without hardlink support
+        shutil.copy2(src, dst)
+
+
+def protect_last(path: str | Path) -> Optional[Path]:
+    """Clone a committed+manifested snapshot to ``<name>.prev`` (hardlinks
+    — ~zero cost, no data copied) BEFORE force=True replaces it, so one
+    verified snapshot exists at every instant of a save. Without this, a
+    kill between the overwrite and the new manifest leaves the newest
+    snapshot unverifiable (quarantined on discovery) and — when no
+    ``.epoch_N``/``.best`` sibling survives — auto-resume restarts from
+    scratch. The clone's manifest is written last (atomically), so a kill
+    mid-clone never creates an unverifiable discovery candidate. Returns
+    the clone path, or None when there is nothing verified to protect."""
+    path = Path(path).absolute()
+    mpath = manifest_path(path)
+    if not path.is_dir() or not mpath.exists():
+        return None
+    dest = Path(str(path) + PREV_SUFFIX)
+    unprotect_prev(path)  # clear a stale clone from an earlier crash
+    for p in sorted(path.rglob("*")):
+        rel = p.relative_to(path)
+        if p.is_dir():
+            (dest / rel).mkdir(parents=True, exist_ok=True)
+        else:
+            (dest / rel).parent.mkdir(parents=True, exist_ok=True)
+            _clone_file(p, dest / rel)
+    aux = Path(str(path) + AUX_SUFFIX)
+    if aux.exists():
+        _clone_file(aux, Path(str(dest) + AUX_SUFFIX))
+    # the manifest's file keys are relative (ckpt/..., aux), so the
+    # original's document is valid for the clone verbatim
+    mdest = manifest_path(dest)
+    tmp = mdest.with_name(mdest.name + ".tmp")
+    tmp.write_text(mpath.read_text())
+    os.replace(tmp, mdest)
+    return dest
+
+
+def unprotect_prev(path: str | Path) -> None:
+    """Delete ``<name>.prev`` — manifest first, so a kill mid-delete
+    demotes the clone to a non-candidate instead of leaving an
+    unverifiable one. Only call once the replacement snapshot's own
+    manifest is on disk."""
+    dest = Path(str(Path(path).absolute()) + PREV_SUFFIX)
+    m = manifest_path(dest)
+    if m.exists():
+        m.unlink()
+    aux = Path(str(dest) + AUX_SUFFIX)
+    if aux.exists():
+        aux.unlink()
+    if dest.is_dir():
+        shutil.rmtree(dest, ignore_errors=True)
+
+
+# -------------------------------------------------- pending async manifests
+def queue_manifest(path: str | Path, epoch: int) -> None:
+    """Record that `path`'s async commit, once landed, is owed a manifest
+    for `epoch`."""
+    _pending_manifests[str(Path(path).absolute())] = int(epoch)
+
+
+def drop_queued_manifest(path: str | Path) -> None:
+    """Forget a queued manifest — the snapshot is about to be overwritten
+    (force=True re-save of model_last/.best), so the queued manifest would
+    describe files that no longer exist."""
+    _pending_manifests.pop(str(Path(path).absolute()), None)
+
+
+def flush_queued_manifests() -> None:
+    """Write every queued manifest. Only call when the corresponding
+    commits are known to have landed: after ``wait_until_finished`` +
+    ``check_for_errors``, or for entries strictly older than a save that
+    has since been enqueued (orbax serializes commits)."""
+    for p, ep in list(_pending_manifests.items()):
+        _pending_manifests.pop(p, None)
+        if Path(p).is_dir():
+            write_manifest(p, ep)
+            unprotect_prev(p)  # the new manifest is down — the fallback
+                               # clone has done its job
+
+
+# --------------------------------------------------------- retention + sweep
+def sweep_stale(folder: str | Path) -> List[str]:
+    """Startup sweep of a checkpoint/run folder: delete orphaned write
+    debris a crash can leave behind — ``*.tmp`` files (aux-sidecar /
+    manifest / recorder tempfiles whose ``os.replace`` never ran) and
+    uncommitted orbax tmp dirs. Returns (and logs) what was removed."""
+    folder = Path(folder).absolute()
+    removed: List[str] = []
+    if not folder.is_dir():
+        return removed
+    for p in sorted(folder.glob("*.tmp")):
+        if p.is_file():
+            p.unlink()
+            removed.append(p.name)
+    for p in sorted(folder.glob(ORBAX_TMP_GLOB)):
+        if p.is_dir():
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p.name + "/")
+    if removed:
+        logger.warning("startup sweep of %s removed %d stale artifact(s): "
+                       "%s", folder, len(removed), ", ".join(removed))
+    return removed
+
+
+class CheckpointManager:
+    """Per-run-folder policy around the plain save/load functions above:
+    integrity manifests (immediate for sync saves, deferred-until-committed
+    for async ones), retention GC, and the startup sweep. Pure host-side
+    bookkeeping — it never touches the device."""
+
+    def __init__(self, folder: Optional[Path], *, keep_last_n: int = 0,
+                 manifests: bool = True):
+        self.folder = Path(folder) if folder is not None else None
+        self.keep_last_n = int(keep_last_n)
+        self.manifests = bool(manifests)
+
+    # ------------------------------------------------------------- manifests
+    def prepare_overwrite(self, paths: List[Path], async_save: bool,
+                          writer: bool = True) -> None:
+        """Call BEFORE re-saving existing snapshot paths with force=True.
+        For async saves, first land the in-flight commit and write the
+        manifests it was owed — orbax serializes saves, so the upcoming
+        enqueue would block on that commit anyway; waiting here moves the
+        wait, it doesn't add one, and it means ``model_last`` carries an
+        on-disk manifest between rounds (without this, a ``kill -9`` of a
+        pipelined run with ``save_on_epochs: []`` would leave ZERO
+        verified snapshots and auto-resume would restart from scratch).
+        Then drop any still-queued manifests for the paths about to be
+        replaced — they would describe dirs the new save deletes — and
+        clone each verified snapshot to ``<name>.prev`` so a kill at ANY
+        point of the upcoming save still leaves a verified resume point
+        (:func:`protect_last`; the clone is dropped once the replacement's
+        manifest lands, in :meth:`note_saved` / the flush). `writer` gates
+        the filesystem mutations to one process, like the sidecar."""
+        if not self.manifests or not writer:
+            return
+        if async_save and _pending_manifests:
+            wait_for_async_saves()
+        for p in paths:
+            drop_queued_manifest(p)
+            protect_last(p)
+
+    def note_saved(self, paths: List[Path], epoch: int,
+                   async_save: bool) -> None:
+        """Call AFTER a round's snapshots (and their sidecars) are written.
+        Sync saves get their manifests immediately. Async saves: manifests
+        queued from *previous* rounds are now provably committed (this
+        round's enqueue blocked until they landed — orbax serializes), so
+        flush them, then queue this round's."""
+        if not self.manifests:
+            return
+        if not async_save:
+            for p in paths:
+                write_manifest(p, epoch)
+                unprotect_prev(p)  # replacement verified — drop the clone
+            return
+        flush_queued_manifests()
+        for p in paths:
+            queue_manifest(p, epoch)
+
+    def flush_manifests(self) -> None:
+        """End-of-run manifest flush; only valid after
+        :func:`wait_for_async_saves` (which already calls this)."""
+        if self.manifests:
+            flush_queued_manifests()
+
+    # ------------------------------------------------------------------ sweep
+    def sweep(self) -> List[str]:
+        return sweep_stale(self.folder) if self.folder is not None else []
+
+    # --------------------------------------------------------------------- gc
+    def gc(self) -> List[Path]:
+        """Retention: with ``keep_last_n > 0``, delete per-epoch snapshots
+        (``*.epoch_N`` + sidecar + manifest) beyond the newest N.
+        ``model_last`` and the best-val snapshot are always retained, and
+        snapshots with an in-flight async commit are skipped. Default
+        (``keep_last_n: 0``) keeps everything — ``save_on_epochs`` lists
+        are explicit user asks."""
+        if self.keep_last_n <= 0 or self.folder is None:
+            return []
+        snaps = []
+        for p in self.folder.iterdir():
+            if not p.is_dir() or CORRUPT_SUFFIX in p.name:
+                continue
+            _, sep, tail = p.name.rpartition(".epoch_")
+            if not sep or not tail.isdigit():
+                continue
+            snaps.append((int(tail), p))
+        snaps.sort()
+        doomed = [p for _, p in snaps[:-self.keep_last_n]
+                  if str(p.absolute()) not in _pending_manifests]
+        for p in doomed:
+            shutil.rmtree(p, ignore_errors=True)
+            for extra in (Path(str(p) + AUX_SUFFIX), manifest_path(p)):
+                if extra.exists():
+                    extra.unlink()
+            telemetry.count("checkpoint/gc_removed")
+        if doomed:
+            logger.info("checkpoint GC (keep_last_n=%d) removed %s",
+                        self.keep_last_n, [p.name for p in doomed])
+        return doomed

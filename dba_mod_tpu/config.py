@@ -152,6 +152,32 @@ _DEFAULTS: Dict[str, Any] = {
     "min_surviving_clients": 1,    # fewer survivors → skip aggregation,
                                    # carry the global model, mark the round
                                    # degraded
+    # --- crash/preemption tolerance (utils/run_guard.py, checkpoint.py;
+    #     README "Crash & preemption tolerance") ---
+    # resumed_model additionally accepts the string "auto": discover the
+    # newest VERIFIED checkpoint across run_dir's run folders, reuse that
+    # run folder, and continue its recorder stream past the resume epoch
+    "graceful_shutdown": False,    # SIGTERM/SIGINT → finish the round,
+                                   # write a final verified checkpoint,
+                                   # flush recorder/telemetry, exit 75;
+                                   # second signal forces immediate exit.
+                                   # Off = no signal handlers installed
+    "watchdog_soft_s": 0.0,        # stall diagnostic (span stack, epoch,
+                                   # elapsed) when a host sync point blocks
+                                   # this long; 0 = off (no thread)
+    "watchdog_hard_s": 0.0,        # abort the process (exit 76) when a
+                                   # sync point blocks this long — a wedged
+                                   # run dies checkpointed instead of
+                                   # burning quota; 0 = off
+    "checkpoint_manifests": True,  # write + verify per-snapshot integrity
+                                   # manifests (sha256 over the orbax step
+                                   # dir + aux sidecar); required for
+                                   # resumed_model: auto, which restores
+                                   # only verified snapshots
+    "keep_last_n": 0,              # checkpoint retention: keep only the
+                                   # newest N *.epoch_N snapshots
+                                   # (model_last and .best always kept);
+                                   # 0 = keep all
 }
 
 
@@ -190,6 +216,27 @@ class Params:
             raise ValueError("max_round_retries must be >= 0")
         if int(merged["min_surviving_clients"]) < 1:
             raise ValueError("min_surviving_clients must be >= 1")
+        rm = merged["resumed_model"]
+        if not isinstance(rm, bool) and rm != "auto":
+            raise ValueError(
+                f"resumed_model must be true/false/'auto', got {rm!r}")
+        if rm == "auto" and not bool(merged["checkpoint_manifests"]):
+            # auto-resume restores only VERIFIED snapshots — without
+            # manifests it can never find one and every relaunch would
+            # silently discard all progress
+            raise ValueError(
+                "resumed_model: auto requires checkpoint_manifests: true "
+                "(auto-resume only restores manifest-verified checkpoints)")
+        soft = float(merged["watchdog_soft_s"])
+        hard = float(merged["watchdog_hard_s"])
+        if soft < 0 or hard < 0:
+            raise ValueError("watchdog_soft_s/watchdog_hard_s must be >= 0")
+        if 0 < hard < soft:
+            raise ValueError(
+                f"watchdog_hard_s ({hard}) must be >= watchdog_soft_s "
+                f"({soft}) — the soft diagnostic must fire before the abort")
+        if int(merged["keep_last_n"]) < 0:
+            raise ValueError("keep_last_n must be >= 0")
         return cls(raw=merged)
 
     # ------------------------------------------------------------- dict access
@@ -214,6 +261,15 @@ class Params:
     @property
     def aggregation(self) -> str:
         return self.raw["aggregation_methods"]
+
+    @property
+    def resume_mode(self) -> str:
+        """'off' | 'named' (checkpoint_dir/resumed_model_name) | 'auto'
+        (discover the newest verified checkpoint under run_dir)."""
+        rm = self.raw["resumed_model"]
+        if rm == "auto":
+            return "auto"
+        return "named" if rm else "off"
 
     @property
     def adversary_list(self) -> List[Any]:
@@ -304,9 +360,14 @@ class Params:
         return out
 
     # ---------------------------------------------------------------- run dir
+    def write_yaml(self, folder: Path) -> None:
+        """Record the effective config in a run folder (overwrites — an
+        auto-resumed run re-records the config it resumed with)."""
+        with open(Path(folder) / "params.yaml", "w") as f:
+            yaml.dump(self.raw, f)
+
     def make_run_folder(self) -> Path:
         folder = Path(self.raw["run_dir"]) / f"{self.type}_{self.current_time}"
         folder.mkdir(parents=True, exist_ok=True)
-        with open(folder / "params.yaml", "w") as f:
-            yaml.dump(self.raw, f)
+        self.write_yaml(folder)
         return folder

@@ -33,7 +33,7 @@ from dba_mod_tpu.fl.selection import select_agents
 from dba_mod_tpu.fl.state import build_client_tasks
 from dba_mod_tpu.models import ModelVars, build_model, compute_dtype_of
 from dba_mod_tpu.ops.aggregation import foolsgold_init
-from dba_mod_tpu.utils import telemetry
+from dba_mod_tpu.utils import run_guard, telemetry
 from dba_mod_tpu.utils.recorder import Recorder
 
 logger = logging.getLogger("dba_mod_tpu")
@@ -102,8 +102,33 @@ class Experiment:
         from dba_mod_tpu.parallel.distributed import initialize_distributed
         initialize_distributed()  # env-triggered; no-op single-host
         self.params = params
-        self.folder: Optional[Path] = (params.make_run_folder()
-                                       if save_results else None)
+        # crash/preemption guard (utils/run_guard.py): stop flag checked at
+        # round boundaries + watchdog around host sync points. Construction
+        # is side-effect free; run() installs/uninstalls the handlers.
+        # Strict no-op (no threads, no handlers) with the default knobs.
+        self.guard = run_guard.RunGuard.from_params(params)
+        self.interrupted = False
+        self._ckpt_mgr: Optional[ckpt.CheckpointManager] = None
+        # resumed_model: auto — discover the newest VERIFIED checkpoint
+        # across run_dir's run folders BEFORE creating a new folder: the
+        # resumed run re-enters the killed run's folder and continues its
+        # recorder stream, instead of scattering each retry into a fresh
+        # timestamped dir
+        self._auto_resume_path: Optional[Path] = None
+        resumed_folder: Optional[Path] = None
+        if params.resume_mode == "auto":
+            hit = ckpt.find_auto_resume(Path(str(params["run_dir"])),
+                                        params.type)
+            if hit is not None:
+                resumed_folder, self._auto_resume_path = hit
+        if not save_results:
+            self.folder: Optional[Path] = None
+        elif resumed_folder is not None:
+            self.folder = resumed_folder
+            ckpt.sweep_stale(self.folder)  # crash debris: *.tmp, orbax tmp
+            params.write_yaml(self.folder)
+        else:
+            self.folder = params.make_run_folder()
         # idempotent logger setup (telemetry.py): one stream handler, one
         # run-folder file handler that FOLLOWS the active experiment —
         # replaces the old basicConfig + per-instance FileHandler stacking
@@ -152,17 +177,44 @@ class Experiment:
         self.global_vars = self.model_def.init_vars(init_rng)
         self.start_epoch = 1
         self._resume_aux: Optional[Dict[str, Any]] = None
-        if params["resumed_model"]:
+        resume_path: Optional[Path] = None
+        if params.resume_mode == "auto":
+            resume_path = self._auto_resume_path
+            if resume_path is None:
+                logger.warning(
+                    "resume auto: no verified checkpoint under %s — "
+                    "starting a fresh run", params["run_dir"])
+        elif params.resume_mode == "named":
             path = (Path(str(params.get("checkpoint_dir", "saved_models")))
                     / str(params["resumed_model_name"]))
+            # integrity gate: verified → load; manifest-less (pretrain/
+            # legacy) → load unverified, the reference behavior; corrupt →
+            # fall back to the newest verified SAME-NAME sibling. No sweep
+            # and no quarantine here: checkpoint_dir is a shared library
+            # (another process may be mid-commit into it), unlike the
+            # exclusively owned run folder swept in __init__
+            resume_path = ckpt.resolve_verified(path)
+        if resume_path is not None:
             self.global_vars, saved_epoch, saved_lr = ckpt.load_checkpoint(
-                path, self.global_vars)
-            self.start_epoch = saved_epoch + 1
+                resume_path, self.global_vars)
+            if params.resume_mode == "auto":
+                # the checkpoint records the completed round's BASE epoch;
+                # with aggr_epoch_interval > 1 that round also trained the
+                # interval-1 following epochs, and the killed run's round
+                # grid steps by the interval — continuing the exact
+                # trajectory means the next base, not base+1 (which would
+                # re-train epoch base+1 and shift the whole grid)
+                self.start_epoch = (saved_epoch
+                                    + int(params["aggr_epoch_interval"]))
+            else:
+                # named resume keeps the reference's +1 semantics
+                self.start_epoch = saved_epoch + 1
             self.params.raw["lr"] = saved_lr
             # full-state sidecar, when the checkpoint has one (save_model
             # runs write it; pretrain checkpoints don't — model-only resume
-            # is the reference behavior, image_helper.py:56-67)
-            self._resume_aux = ckpt.load_aux_state(path)
+            # is the reference behavior, image_helper.py:56-67). A corrupt
+            # sidecar also degrades to model-only (checkpoint.py).
+            self._resume_aux = ckpt.load_aux_state(resume_path)
             if (self._resume_aux is not None
                     and int(self._resume_aux["epoch"]) != saved_epoch):
                 # a crash between the (synchronous) sidecar write and the
@@ -175,9 +227,21 @@ class Experiment:
                     "(model-only resume; FoolsGold memory and RNG streams "
                     "restart)", int(self._resume_aux["epoch"]), saved_epoch)
                 self._resume_aux = None
-            logger.info("resumed %s: lr=%s start_epoch=%d aux=%s", path,
-                        saved_lr, self.start_epoch,
+            logger.info("resumed %s: lr=%s start_epoch=%d aux=%s",
+                        resume_path, saved_lr, self.start_epoch,
                         self._resume_aux is not None)
+            if params.resume_mode == "auto" and self.folder is not None:
+                # continue the killed run's recorder stream: reload rows
+                # through the resume round's FINAL global epoch and drop
+                # the rest — a kill can land after round N recorded but
+                # before its checkpoint verified, and the replayed round N
+                # must not appear twice in metrics.jsonl/round_result.csv
+                cut = saved_epoch + int(params["aggr_epoch_interval"]) - 1
+                kept = self.recorder.load_from_folder(cut)
+                logger.info(
+                    "resume auto: continuing recorder stream in %s "
+                    "(%d metrics rows kept through epoch %d)",
+                    self.folder, kept, cut)
 
         # clients mesh: 0 → single-device; -1 → all visible devices; n → n
         nd = int(params.get("num_devices", 0))
@@ -527,8 +591,9 @@ class Experiment:
             if self.stale_poison_probe and self.last_backdoor_acc is not None:
                 backdoor_acc = self.last_backdoor_acc  # round N-1's battery
             else:
-                backdoor_acc = float(self.engine.backdoor_acc_fn(
-                    self.global_vars))
+                with self.guard.watch("round/poison_probe"):
+                    backdoor_acc = float(self.engine.backdoor_acc_fn(
+                        self.global_vars))
 
         slots = np.array([self.client_slots[n] for n in agent_names],
                          np.int64)
@@ -631,7 +696,8 @@ class Experiment:
             train = self._train_sequential(tasks_seq, idx_seq, mask_seq,
                                            rng_train)
         else:
-            with self.telemetry.span("round/train"):
+            with self.guard.watch("round/train"), \
+                    self.telemetry.span("round/train"):
                 train = self.engine.train_fn(self.global_vars, tasks_seq,
                                              idx_seq, mask_seq, lane,
                                              rng_train)
@@ -652,7 +718,8 @@ class Experiment:
         tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
         tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
         from dba_mod_tpu.fl.rounds import nbt_client_deltas
-        with self.telemetry.span("round/aggregate"):
+        with self.guard.watch("round/aggregate"), \
+                self.telemetry.span("round/aggregate"):
             result = self.engine.aggregate_fn(
                 self.global_vars, self.fg_state, train.deltas,
                 train.fg_grads, train.fg_feature,
@@ -757,7 +824,8 @@ class Experiment:
             if not self.engine.screening:
                 finite = True  # unscreened injection: faults flow through
                 break
-            with self.telemetry.span("round/screen_sync"):
+            with self.guard.watch("round/screen_sync"), \
+                    self.telemetry.span("round/screen_sync"):
                 finite = bool(payload[9].global_finite)  # the one host sync
             if finite or retries >= self.max_round_retries:
                 break
@@ -807,7 +875,10 @@ class Experiment:
     def finalize_round(self, fl: RoundInFlight) -> Dict[str, Any]:
         t_fin = time.perf_counter()
         self.telemetry.set_epoch(fl.epoch)
-        with self.telemetry.span("round/finalize"):
+        # the round's one blocking transfer — the sync point where a wedged
+        # runtime stalls, hence the watchdog zone (run_guard.py)
+        with self.guard.watch("round/finalize"), \
+                self.telemetry.span("round/finalize"):
             (locals_, globals_, metrics, delta_norms, wv, alpha,
              batches, is_updated, seg_locals, rstats) = jax.device_get(
                  fl.payload)
@@ -1079,16 +1150,34 @@ class Experiment:
         rec.save(self.is_poison_run)
 
     # ------------------------------------------------------------------- run
+    @property
+    def checkpoint_manager(self) -> ckpt.CheckpointManager:
+        """Manifest/retention policy bound to the CURRENT run folder —
+        rebuilt when the folder changes (tests reassign ``exp.folder``
+        after construction). Pending async-manifest state is module-level
+        in checkpoint.py, so a rebuild loses nothing."""
+        if self._ckpt_mgr is None or self._ckpt_mgr.folder != self.folder:
+            self._ckpt_mgr = ckpt.CheckpointManager(
+                self.folder,
+                keep_last_n=int(self.params.get("keep_last_n", 0)),
+                manifests=bool(self.params.get("checkpoint_manifests",
+                                               True)))
+        return self._ckpt_mgr
+
     def save_model(self, epoch: int, fl: Optional[RoundInFlight] = None,
                    async_save: bool = False):
         """Checkpoint the round's post-aggregation state. With `fl`, saves
         the state captured at that round's dispatch (required under
         pipelining — the live attributes already belong to the next round);
         `async_save` routes through orbax's AsyncCheckpointer so the commit
-        overlaps the next round's compute (run() waits before returning)."""
+        overlaps the next round's compute (run() waits before returning).
+        Every committed snapshot gets an integrity manifest (immediately
+        for sync saves; once the commit provably landed for async ones),
+        then retention GC runs (checkpoint.py::CheckpointManager)."""
         params = self.params
         if not params["save_model"] or self.folder is None:
             return
+        mgr = self.checkpoint_manager
         with self.telemetry.span("round/checkpoint"):
             model_vars = fl.vars_after if fl is not None else self.global_vars
             fg_state = fl.fg_after if fl is not None else self.fg_state
@@ -1096,21 +1185,22 @@ class Experiment:
             path = self.folder / "model_last.pt.tar"
             lr = float(params["lr"])
             written = [path]
-            ckpt.save_checkpoint(path, model_vars, epoch, lr,
-                                 async_save=async_save)
             if epoch in list(params["save_on_epochs"]):
-                p = Path(str(path) + f".epoch_{epoch}")
-                ckpt.save_checkpoint(p, model_vars, epoch, lr,
-                                     async_save=async_save)
-                written.append(p)
+                written.append(Path(str(path) + f".epoch_{epoch}"))
             # best-val snapshot whenever the global eval loss improves
             # (helper.py:433-435, called with epoch_loss from main.py:233)
             if self.last_global_loss < self.best_loss:
-                p = Path(str(path) + ".best")
+                written.append(Path(str(path) + ".best"))
+                self.best_loss = self.last_global_loss
+            # before force=True replaces committed snapshots: land owed
+            # async manifests, drop queued ones for the doomed dirs, and
+            # clone each verified snapshot to <name>.prev so a kill at any
+            # instant of this save leaves a verified resume point
+            mgr.prepare_overwrite(written, async_save,
+                                  writer=jax.process_index() == 0)
+            for p in written:
                 ckpt.save_checkpoint(p, model_vars, epoch, lr,
                                      async_save=async_save)
-                written.append(p)
-                self.best_loss = self.last_global_loss
             # full-state sidecar (deviation, documented in checkpoint.py):
             # the reference loses FoolsGold memory / best loss / RNG position
             # on restart; we persist them so resume replays the exact
@@ -1127,16 +1217,37 @@ class Experiment:
                        **rng}
                 for p in written:
                     ckpt.save_aux_state(p, aux)
+            if jax.process_index() == 0:  # one manifest/GC writer
+                # manifests cover the step dir + the sidecar when one was
+                # written (sharded-fg multi-host runs skip the sidecar but
+                # must still get verifiable — hence resumable — snapshots);
+                # sync saves get them now, async ones once committed
+                mgr.note_saved(written, epoch, async_save=async_save)
+                mgr.gc()
 
     def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
-        try:
-            return self._run_rounds(epochs)
-        finally:
-            # end-of-run telemetry: final trace.json flush + the printed
-            # phase-summary table (p50/p95 per span, recompile count, peak
-            # device memory) — also on a mid-run exception, so a crashed
-            # run still leaves a loadable trace
-            self._finish_telemetry()
+        self.interrupted = False
+        # the guard context installs the SIGTERM/SIGINT handlers around the
+        # run loop (and restores the previous ones after) — a no-op unless
+        # graceful_shutdown is on
+        with self.guard:
+            try:
+                return self._run_rounds(epochs)
+            finally:
+                try:
+                    # EVERY exit path — normal return, graceful stop, or a
+                    # mid-run exception — must land the in-flight async
+                    # commit (force=True already deleted the previous
+                    # model_last) and write the manifests it was owed
+                    with self.guard.watch("checkpoint/wait_async"):
+                        ckpt.wait_for_async_saves()
+                finally:
+                    # end-of-run telemetry: final trace.json flush + the
+                    # printed phase-summary table (p50/p95 per span,
+                    # recompile count, peak device memory) — also on a
+                    # mid-run exception, so a crashed run still leaves a
+                    # loadable trace
+                    self._finish_telemetry()
 
     def _finish_telemetry(self) -> None:
         t = self.telemetry
@@ -1180,23 +1291,27 @@ class Experiment:
                             r["backdoor_acc"])
                 return r
 
+            # (run()'s finally holds the wait_for_async_saves that used to
+            # live here — it now covers every exit path, not just this one)
             pending: Optional[RoundInFlight] = None
-            try:
-                for epoch in range(self.start_epoch, end + 1, self.interval):
-                    fl = self.dispatch_round(epoch)
-                    if pending is not None:
-                        last = finalize_and_log(pending)
-                    pending = fl
+            for epoch in range(self.start_epoch, end + 1, self.interval):
+                if self.guard.stop_requested:
+                    self._note_interrupted(epoch)
+                    break
+                fl = self.dispatch_round(epoch)
                 if pending is not None:
                     last = finalize_and_log(pending)
-            finally:
-                # even on a mid-run exception, the in-flight async commit
-                # must land — force=True already deleted the previous
-                # model_last, so abandoning the commit would lose the
-                # newest checkpoint entirely
-                ckpt.wait_for_async_saves()
+                pending = fl
+            if pending is not None:
+                last = finalize_and_log(pending)
             return last
         for epoch in range(self.start_epoch, end + 1, self.interval):
+            if self.guard.stop_requested:
+                # round-boundary stop: the previous round's save_model
+                # already committed a verified checkpoint and the recorder
+                # saved — nothing mid-flight to lose
+                self._note_interrupted(epoch)
+                break
             if profile_dir and epoch == self.start_epoch + self.interval:
                 # trace the first post-compile round (SURVEY §5 tracing row)
                 with jax.profiler.trace(profile_dir):
@@ -1209,3 +1324,14 @@ class Experiment:
                         epoch, last["round_time"], last["global_acc"],
                         last["backdoor_acc"])
         return last
+
+    def _note_interrupted(self, next_epoch: int) -> None:
+        """A graceful-stop request was honored at a round boundary: record
+        it so the CLI can exit with run_guard.EXIT_INTERRUPTED and a
+        wrapper can relaunch with ``--resume auto``."""
+        self.interrupted = True
+        telemetry.count("run/interrupted")
+        logger.warning(
+            "graceful stop honored at the round boundary before epoch %d — "
+            "writing final state and exiting (resume with --resume auto)",
+            next_epoch)

@@ -25,13 +25,37 @@ from dba_mod_tpu.config import Params
 
 def _train(args) -> int:
     from dba_mod_tpu.fl.experiment import Experiment
+    from dba_mod_tpu.utils import run_guard
     params = Params.from_yaml(args.params)
     if args.epochs is not None:
         params.raw["epochs"] = args.epochs
     if args.synthetic:
         params.raw["synthetic_data"] = True
+    if args.resume:
+        if args.resume == "auto":
+            # discover + continue the newest verified checkpoint under
+            # run_dir (README "Crash & preemption tolerance"). Same guard
+            # as config.py's validation — the CLI override lands after
+            # from_yaml, so re-check the combination it would reject
+            if not bool(params.raw.get("checkpoint_manifests", True)):
+                raise SystemExit(
+                    "--resume auto requires checkpoint_manifests: true "
+                    "(auto-resume only restores manifest-verified "
+                    "checkpoints; with manifests off every relaunch "
+                    "would silently start a fresh run)")
+            params.raw["resumed_model"] = "auto"
+        else:
+            params.raw.update(resumed_model=True,
+                              resumed_model_name=args.resume)
     exp = Experiment(params, save_results=not args.no_save)
     last = exp.run()
+    if exp.interrupted:
+        # graceful SIGTERM/SIGINT stop: distinct exit code so run wrappers
+        # know to relaunch with --resume auto rather than report failure
+        done = last.get("epoch") if last else exp.start_epoch - 1
+        print(f"interrupted: graceful stop after epoch {done} — "
+              f"resume with --resume auto")
+        return run_guard.EXIT_INTERRUPTED
     if not last:  # resume checkpoint already at/after the final epoch
         print(f"no rounds to run: start_epoch={exp.start_epoch} > "
               f"epochs={params['epochs']}")
@@ -110,6 +134,12 @@ def build_parser() -> argparse.ArgumentParser:
     train = sub.add_parser("train", help="run an FL experiment (default)")
     common(train)
     train.add_argument("--no-save", action="store_true")
+    train.add_argument(
+        "--resume", default=None, metavar="auto|NAME",
+        help="'auto': discover the newest verified checkpoint under "
+             "run_dir, reuse that run folder and continue its recorder "
+             "stream; any other value resumes checkpoint_dir/NAME "
+             "(overrides the YAML's resumed_model keys)")
     pre = sub.add_parser("pretrain", help="train+save a clean model")
     common(pre)
     pre.add_argument("--out", default=None,

@@ -158,6 +158,9 @@ class _NullTelemetry:
     def phase(self) -> str:
         return "-"
 
+    def span_stack(self) -> List[str]:
+        return []
+
     def set_epoch(self, epoch: Optional[int]) -> None:
         pass
 
@@ -279,6 +282,13 @@ class Telemetry:
     def phase(self) -> str:
         stack = getattr(self._local, "stack", None)
         return stack[-1] if stack else "-"
+
+    def span_stack(self) -> List[str]:
+        """Copy of the calling thread's open-span stack (thread-local —
+        callers that need another thread's stack must capture it *in* that
+        thread, e.g. the watchdog captures at zone entry)."""
+        stack = getattr(self._local, "stack", None)
+        return list(stack) if stack else []
 
     def set_epoch(self, epoch: Optional[int]) -> None:
         self.current_epoch = epoch

@@ -1,0 +1,358 @@
+"""Checkpoint integrity manifests, quarantine/fallback, retention GC,
+startup sweep, and `resumed_model: auto` (checkpoint.py + the Experiment
+wiring). The subprocess kill/-9 end-to-end lives in
+tests/test_crash_harness.py; everything here is in-process and cheap."""
+import json
+from pathlib import Path
+
+import pytest
+
+from dba_mod_tpu import checkpoint as ckpt
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.experiment import Experiment
+
+CFG = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=6, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False, random_seed=3,
+    save_model=True)
+
+VOLATILE = {"time", "round_time", "dispatch_time", "finalize_time"}
+
+
+@pytest.fixture
+def dba_log(caplog):
+    """caplog wired to the 'dba_mod_tpu' logger directly: setup_logging
+    (telemetry.py) sets propagate=False once a result-saving Experiment
+    exists in the process, so root-level capture sees nothing."""
+    import logging
+    lg = logging.getLogger("dba_mod_tpu")
+    lg.addHandler(caplog.handler)
+    with caplog.at_level("WARNING", logger="dba_mod_tpu"):
+        yield caplog
+    lg.removeHandler(caplog.handler)
+
+
+def _strip(row):
+    return {k: v for k, v in row.items() if k not in VOLATILE}
+
+
+def _metrics_rows(folder):
+    with open(Path(folder) / "metrics.jsonl") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _flip_byte(path: Path, offset_frac=0.5):
+    data = bytearray(path.read_bytes())
+    data[int(len(data) * offset_frac) % len(data)] ^= 0xFF
+    path.write_bytes(bytes(data))
+
+
+def _largest_data_file(step_dir: Path) -> Path:
+    return max((p for p in step_dir.rglob("*") if p.is_file()),
+               key=lambda p: p.stat().st_size)
+
+
+def _run(cfg, epochs, save_results=True):
+    e = Experiment(Params.from_dict(cfg), save_results=save_results)
+    e.run(epochs)
+    return e
+
+
+# ---------------------------------------------------------------- manifests
+def test_manifest_verify_roundtrip(tmp_path):
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs")), 2)
+    path = e.folder / "model_last.pt.tar"
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert ok and reason == ckpt.VERIFY_OK
+    assert ckpt.manifest_epoch(path) == 2
+    doc = json.loads(ckpt.manifest_path(path).read_text())
+    assert "aux" in doc["files"]  # the sidecar is covered too
+
+
+def test_no_manifest_is_distinguished_from_corrupt(tmp_path):
+    like = Experiment(Params.from_dict(dict(CFG, save_model=False)),
+                      save_results=False)
+    p = tmp_path / "m.pt.tar"
+    ckpt.save_checkpoint(p, like.global_vars, 1, 0.1)
+    ok, reason = ckpt.verify_checkpoint(p)
+    assert not ok and reason == ckpt.VERIFY_NO_MANIFEST
+    # resolve_verified accepts legacy (pretrain-style) snapshots as-is
+    assert ckpt.resolve_verified(p) == p.absolute()
+    with pytest.raises(FileNotFoundError):
+        ckpt.resolve_verified(tmp_path / "never_saved.pt.tar")
+
+
+def test_flipped_model_byte_detected_quarantined_and_fallback(tmp_path):
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs"),
+                  save_on_epochs=[1, 2, 3]), 3)
+    folder = e.folder
+    # corrupt the two newest snapshots (model_last and .epoch_3 both hold
+    # epoch 3; .best may too — kill it as well so the fallback is epoch 2)
+    for name in ("model_last.pt.tar", "model_last.pt.tar.epoch_3",
+                 "model_last.pt.tar.best"):
+        _flip_byte(_largest_data_file(folder / name))
+    best = ckpt.latest_verified_checkpoint(folder)
+    assert best is not None and best.name == "model_last.pt.tar.epoch_2"
+    quarantined = sorted(p.name for p in folder.iterdir()
+                         if ckpt.CORRUPT_SUFFIX in p.name)
+    assert quarantined == ["model_last.pt.tar.best.corrupt",
+                           "model_last.pt.tar.corrupt",
+                           "model_last.pt.tar.epoch_3.corrupt"]
+    # the quarantine dir holds the moved pieces for post-mortem
+    q = folder / "model_last.pt.tar.corrupt"
+    assert (q / "model_last.pt.tar").is_dir()
+    assert (q / "model_last.pt.tar.manifest.json").exists()
+
+
+def test_flipped_sidecar_byte_detected_quarantined_and_fallback(tmp_path):
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs"),
+                  save_on_epochs=[1, 2, 3]), 3)
+    folder = e.folder
+    for name in ("model_last.pt.tar", "model_last.pt.tar.epoch_3",
+                 "model_last.pt.tar.best"):
+        _flip_byte(folder / (name + ckpt.AUX_SUFFIX))
+    best = ckpt.latest_verified_checkpoint(folder)
+    assert best is not None and best.name == "model_last.pt.tar.epoch_2"
+    ok, reason = ckpt.verify_checkpoint(best)
+    assert ok, reason
+
+
+def test_corrupt_sidecar_without_manifest_degrades_to_model_only(tmp_path,
+                                                                 dba_log):
+    like = Experiment(Params.from_dict(dict(CFG, save_model=False)),
+                      save_results=False)
+    p = tmp_path / "m.pt.tar"
+    ckpt.save_checkpoint(p, like.global_vars, 1, 0.1)
+    (tmp_path / ("m.pt.tar" + ckpt.AUX_SUFFIX)).write_bytes(
+        b"\x80\x04 truncated garbage")
+    assert ckpt.load_aux_state(p) is None
+    assert any("model-only resume" in r.getMessage()
+               for r in dba_log.records)
+    # and a resume over it still works (reference model-only semantics)
+    cfg = dict(CFG, save_model=False, checkpoint_dir=str(tmp_path),
+               resumed_model=True, resumed_model_name="m.pt.tar")
+    r = Experiment(Params.from_dict(cfg), save_results=False)
+    assert r.start_epoch == 2 and r._resume_aux is None
+
+
+# -------------------------------------------------------------- sweep + gc
+def test_startup_sweep_removes_stale_tmp_artifacts(tmp_path, dba_log):
+    folder = tmp_path / "f"
+    folder.mkdir()
+    (folder / ("model_last.pt.tar" + ckpt.AUX_SUFFIX + ".tmp")).write_bytes(
+        b"half a pickle")
+    (folder / "metrics.jsonl.tmp").write_text("{}")
+    orphan = folder / "model_last.pt.tar.orbax-checkpoint-tmp-1234"
+    orphan.mkdir()
+    (orphan / "d").mkdir()
+    removed = ckpt.sweep_stale(folder)
+    assert sorted(removed) == [
+        "metrics.jsonl.tmp",
+        "model_last.pt.tar.aux.pkl.tmp",
+        "model_last.pt.tar.orbax-checkpoint-tmp-1234/"]
+    assert not orphan.exists()
+    assert any("startup sweep" in r.getMessage() for r in dba_log.records)
+    assert ckpt.sweep_stale(folder) == []  # idempotent
+
+
+def test_retention_gc_keeps_last_n_best_and_model_last(tmp_path):
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs"), keep_last_n=2,
+                  save_on_epochs=[1, 2, 3, 4, 5]), 5)
+    folder = e.folder
+    dirs = sorted(p.name for p in folder.iterdir() if p.is_dir())
+    assert dirs == ["model_last.pt.tar", "model_last.pt.tar.best",
+                    "model_last.pt.tar.epoch_4",
+                    "model_last.pt.tar.epoch_5"]
+    # sidecars + manifests of the GC'd snapshots are gone too
+    for ep in (1, 2, 3):
+        base = folder / f"model_last.pt.tar.epoch_{ep}"
+        assert not Path(str(base) + ckpt.AUX_SUFFIX).exists()
+        assert not ckpt.manifest_path(base).exists()
+    # survivors are verified
+    for name in dirs:
+        ok, reason = ckpt.verify_checkpoint(folder / name)
+        assert ok, (name, reason)
+
+
+def test_verify_never_raises_on_mangled_manifest(tmp_path):
+    """verify_checkpoint's never-crash contract: valid-JSON-wrong-shape
+    manifests (the plausible products of partial writes and bit rot) must
+    come back as (False, reason), never raise into the resume path."""
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs")), 1)
+    path = e.folder / "model_last.pt.tar"
+    m = ckpt.manifest_path(path)
+    for doc in ('{"version": 1, "epoch": 1, "files": null}',
+                '{"version": 1, "epoch": 1, "files": {"aux": 3}}',
+                '{"version": 1, "epoch": 1, '
+                '"files": {"aux": {"size": "y", "sha256": 1}}}',
+                '[]',
+                '{"epoch": 1}'):
+        m.write_text(doc)
+        ok, reason = ckpt.verify_checkpoint(path)
+        assert not ok and reason, doc
+
+
+def test_async_model_last_has_manifest_between_rounds(tmp_path):
+    """A kill -9 *between* pipelined rounds must still find a verified
+    model_last (with save_on_epochs: [] it is the only snapshot): the
+    manifest owed to async save K is flushed at save K+1's
+    prepare_overwrite — after waiting out commit K, which the K+1 enqueue
+    would have blocked on anyway — not only at run end."""
+    cfg = dict(CFG, run_dir=str(tmp_path / "runs"))
+    e = Experiment(Params.from_dict(cfg), save_results=True)
+    path = e.folder / "model_last.pt.tar"
+    e.run_round(1)
+    e.save_model(1, async_save=True)
+    e.run_round(2)
+    e.save_model(2, async_save=True)
+    # no wait_for_async_saves: mid-run, epoch 1's manifest is on disk
+    assert ckpt.manifest_epoch(path) == 1
+    ckpt.wait_for_async_saves()
+    assert ckpt.manifest_epoch(path) == 2
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert ok, reason
+
+
+def test_prev_clone_protects_mid_save_kill(tmp_path):
+    """The observed kill-mid-save_model state (real kill -9 trace): the
+    in-place model_last re-save landed but its manifest didn't (stale →
+    quarantined on discovery), and the .best force-save died after
+    deleting the old dir. The <name>.prev clone made by prepare_overwrite
+    must be the surviving verified candidate, so auto-resume falls back
+    one round instead of restarting from scratch."""
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs")), 2)
+    folder = e.folder
+    path = folder / "model_last.pt.tar"
+    prev = ckpt.protect_last(path)
+    assert prev is not None and ckpt.verify_checkpoint(prev)[0]
+    import shutil
+    # the round-3 re-save landed (orbax replaces the dir with NEW files —
+    # the .prev hardlinks keep the old inodes) but its manifest didn't,
+    # so model_last's manifest is stale...
+    shutil.rmtree(path)
+    ckpt.save_checkpoint(path, e.global_vars, 3, 0.05)
+    # ...and the .best force-save died after deleting the old dir
+    shutil.rmtree(folder / "model_last.pt.tar.best", ignore_errors=True)
+    best = ckpt.latest_verified_checkpoint(folder)
+    assert best is not None and best.name == "model_last.pt.tar.prev"
+    # unprotect (manifest first) removes the clone entirely
+    ckpt.unprotect_prev(path)
+    assert not prev.exists()
+    assert not ckpt.manifest_path(prev).exists()
+
+
+def test_pipelined_async_saves_all_get_manifests(tmp_path):
+    e = _run(dict(CFG, run_dir=str(tmp_path / "runs"), pipeline_rounds=True,
+                  save_on_epochs=[1, 2, 3, 4]), 4)
+    for name in ("model_last.pt.tar", "model_last.pt.tar.epoch_1",
+                 "model_last.pt.tar.epoch_2", "model_last.pt.tar.epoch_3",
+                 "model_last.pt.tar.epoch_4"):
+        ok, reason = ckpt.verify_checkpoint(e.folder / name)
+        assert ok, (name, reason)
+        assert ckpt.manifest_epoch(e.folder / name) is not None
+
+
+# ------------------------------------------------------------- auto-resume
+def test_auto_resume_continues_same_folder_identical_trajectory(tmp_path):
+    """The in-process half of the e2e acceptance: kill after 3 rounds
+    (simulated by dropping the Experiment), `resumed_model: auto` reuses
+    the run folder, continues the recorder stream with no duplicate
+    rounds, and the full metrics trajectory is bit-identical (modulo
+    wall-clock fields) to an uninterrupted run."""
+    cfg = dict(CFG, run_dir=str(tmp_path / "runs"))
+    ref = _run(dict(cfg, run_dir=str(tmp_path / "runs_ref")), 6)
+    ref_rows = _metrics_rows(ref.folder)
+
+    a = _run(cfg, 3)
+    folder = a.folder
+    del a
+    b = Experiment(Params.from_dict(dict(cfg, resumed_model="auto")),
+                   save_results=True)
+    assert b.folder == folder          # reused, not a fresh timestamped dir
+    assert b.start_epoch == 4
+    assert b._resume_aux is not None   # full-state sidecar restored
+    b.run(6)
+
+    rows = _metrics_rows(folder)
+    assert [r["epoch"] for r in rows] == [1, 2, 3, 4, 5, 6]  # no dupes
+    assert len(ref_rows) == len(rows)
+    for x, y in zip(ref_rows, rows):
+        assert _strip(x) == _strip(y)
+    # round_result.csv continued too
+    lines = (folder / "round_result.csv").read_text().strip().splitlines()
+    assert [line.split(",")[0] for line in lines[1:]] == [
+        "1", "2", "3", "4", "5", "6"]
+
+
+def test_auto_resume_interval_two_stays_on_grid(tmp_path):
+    """aggr_epoch_interval=2: the checkpoint records the completed round's
+    BASE epoch, and that round also trained the following seg epoch — the
+    resumed run must continue at base+interval (the killed run's round
+    grid), not base+1, and the recorder must keep the completed round's
+    rows exactly once."""
+    cfg = dict(CFG, run_dir=str(tmp_path / "runs"), aggr_epoch_interval=2)
+    ref = _run(dict(cfg, run_dir=str(tmp_path / "runs_ref")), 6)
+    ref_rows = _metrics_rows(ref.folder)
+
+    a = _run(cfg, 4)       # rounds at base epochs 1, 3 (seg epochs 1..4)
+    folder = a.folder
+    del a
+    b = Experiment(Params.from_dict(dict(cfg, resumed_model="auto")),
+                   save_results=True)
+    assert b.folder == folder
+    assert b.start_epoch == 5          # next base on the 1,3,5 grid
+    b.run(6)
+
+    rows = _metrics_rows(folder)
+    assert [r["epoch"] for r in rows] == [r["epoch"] for r in ref_rows]
+    for x, y in zip(ref_rows, rows):
+        assert _strip(x) == _strip(y)
+
+
+def test_auto_resume_falls_back_past_corrupt_newest(tmp_path, dba_log):
+    cfg = dict(CFG, run_dir=str(tmp_path / "runs"), save_on_epochs=[1, 2, 3])
+    a = _run(cfg, 3)
+    folder = a.folder
+    del a
+    for name in ("model_last.pt.tar", "model_last.pt.tar.epoch_3",
+                 "model_last.pt.tar.best"):
+        _flip_byte(_largest_data_file(folder / name))
+    b = Experiment(Params.from_dict(dict(cfg, resumed_model="auto")),
+                   save_results=True)
+    assert b.folder == folder
+    assert b.start_epoch == 3  # fell back to the verified epoch-2 snapshot
+    assert any("failed verification" in r.getMessage()
+               for r in dba_log.records)
+    # recorder truncated past the fallback epoch: round 3 will be replayed
+    assert [r["epoch"] for r in b.recorder._jsonl_rows] == [1, 2]
+    b.run(3)
+    assert [r["epoch"] for r in _metrics_rows(folder)] == [1, 2, 3]
+
+
+def test_auto_resume_with_nothing_to_find_starts_fresh(tmp_path, dba_log):
+    cfg = dict(CFG, run_dir=str(tmp_path / "empty_runs"),
+               resumed_model="auto")
+    e = Experiment(Params.from_dict(cfg), save_results=True)
+    assert e.start_epoch == 1
+    assert any("no verified checkpoint" in r.getMessage()
+               for r in dba_log.records)
+
+
+def test_named_resume_of_corrupt_checkpoint_falls_back(tmp_path):
+    cfg = dict(CFG, run_dir=str(tmp_path / "runs"), save_on_epochs=[1, 2])
+    a = _run(cfg, 2)
+    folder = a.folder
+    del a
+    _flip_byte(_largest_data_file(folder / "model_last.pt.tar"))
+    # epoch_2/.best hold epoch 2 verified — the named resume restores a
+    # same-name-family fallback instead of crashing, and (the dir may be
+    # a shared checkpoint library other processes write into) it must NOT
+    # mutate anything: no quarantine, no sweep
+    resume = dict(CFG, checkpoint_dir=str(folder), resumed_model=True,
+                  resumed_model_name="model_last.pt.tar")
+    r = Experiment(Params.from_dict(resume), save_results=False)
+    assert r.start_epoch == 3
+    assert not any(ckpt.CORRUPT_SUFFIX in p.name for p in folder.iterdir())

@@ -1,0 +1,217 @@
+"""utils/run_guard.py: graceful shutdown + watchdog units, the
+round-boundary stop in Experiment.run, and the config validation for the
+new knobs. The end-to-end signal/kill behavior (real SIGTERM/SIGKILL
+against a subprocess) lives in tests/test_crash_harness.py."""
+import logging
+import os
+import signal
+import threading
+import time
+
+import jax
+import pytest
+
+from dba_mod_tpu import checkpoint as ckpt
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.utils import run_guard
+from dba_mod_tpu.utils.run_guard import (EXIT_INTERRUPTED, EXIT_WATCHDOG,
+                                         GracefulShutdown, RunGuard,
+                                         Watchdog)
+
+CFG = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=6, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False, random_seed=3)
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_disabled_is_strict_noop():
+    wd = Watchdog(soft_s=0.0, hard_s=0.0)
+    assert not wd.enabled and wd._thread is None
+    with wd.zone("anything"):
+        pass
+    assert wd._thread is None  # no thread ever started
+
+
+def test_watchdog_soft_then_hard_fire(caplog):
+    fired = []
+    wd = Watchdog(soft_s=0.05, hard_s=0.15, on_hard=lambda: fired.append(1))
+    # an earlier experiment test may have run telemetry's logger setup,
+    # which sets propagate=False on "dba_mod_tpu" — caplog hangs off the
+    # root logger, so force propagation for the capture window
+    lg = logging.getLogger("dba_mod_tpu")
+    prev_propagate = lg.propagate
+    lg.propagate = True
+    try:
+        with caplog.at_level("ERROR", logger="dba_mod_tpu"):
+            with wd.zone("round/finalize"):
+                deadline = time.monotonic() + 5.0
+                while not fired and time.monotonic() < deadline:
+                    time.sleep(0.01)
+    finally:
+        lg.propagate = prev_propagate
+    assert wd.soft_stalls == 1 and wd.hard_aborts == 1 and fired
+    stall = [r for r in caplog.records if "stalled" in r.getMessage()]
+    assert stall and "round/finalize" in stall[0].getMessage()
+
+
+def test_watchdog_fast_zone_fires_nothing():
+    fired = []
+    wd = Watchdog(soft_s=0.5, hard_s=1.0, on_hard=lambda: fired.append(1))
+    for _ in range(5):
+        with wd.zone("quick"):
+            time.sleep(0.01)
+    time.sleep(0.1)  # give the thread a chance to mis-fire
+    assert wd.soft_stalls == 0 and wd.hard_aborts == 0 and not fired
+
+
+def test_watchdog_soft_only_never_aborts():
+    fired = []
+    wd = Watchdog(soft_s=0.05, hard_s=0.0, on_hard=lambda: fired.append(1))
+    with wd.zone("slow"):
+        time.sleep(0.2)
+    assert wd.soft_stalls == 1 and wd.hard_aborts == 0 and not fired
+
+
+# -------------------------------------------------------- graceful shutdown
+def test_shutdown_disabled_installs_no_handlers():
+    before = {s: signal.getsignal(s) for s in GracefulShutdown.SIGNALS}
+    g = GracefulShutdown(enabled=False)
+    g.install()
+    assert not g._prev
+    for s, h in before.items():
+        assert signal.getsignal(s) is h
+    g.uninstall()
+
+
+def test_shutdown_signal_sets_flag_then_second_forces_exit():
+    g = GracefulShutdown(enabled=True)
+    codes = []
+    g._force_exit = codes.append
+    g.install()
+    try:
+        assert not g.stop_requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        # delivery is synchronous in the main thread on return from kill
+        assert g.stop_requested
+        assert not codes
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert codes == [128 + signal.SIGTERM]
+    finally:
+        g.uninstall()
+    # handlers restored
+    assert signal.getsignal(signal.SIGTERM) is not g._handler
+
+
+def test_shutdown_state_resets_on_reinstall():
+    """A second run() on the same Experiment reinstalls the handlers; the
+    previous run's stop flag and signal count must not leak in — a stale
+    count would make the NEXT first signal take the force-exit branch."""
+    g = GracefulShutdown(enabled=True)
+    g._force_exit = lambda code: None
+    g.install()
+    try:
+        g._handler(signal.SIGTERM, None)
+        assert g.stop_requested and g._signal_count == 1
+    finally:
+        g.uninstall()
+    g.install()
+    try:
+        assert not g.stop_requested and g._signal_count == 0
+    finally:
+        g.uninstall()
+
+
+def test_runguard_context_installs_and_restores():
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = RunGuard(graceful_shutdown=True)
+    with guard:
+        assert signal.getsignal(signal.SIGTERM) == guard.shutdown._handler
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_runguard_disabled_watch_is_nullcontext():
+    guard = RunGuard()  # everything off
+    assert not guard.watchdog.enabled
+    assert not guard.shutdown.enabled
+    with guard.watch("x"):
+        pass
+    assert guard.watchdog._thread is None
+    # exit codes are distinct from each other and from success
+    assert len({0, EXIT_INTERRUPTED, EXIT_WATCHDOG}) == 3
+
+
+# -------------------------------------------- round-boundary graceful stop
+def test_run_stops_at_round_boundary_with_verified_checkpoint(tmp_path,
+                                                              monkeypatch):
+    """A stop request lands mid-run: the run finishes the current round,
+    checkpoints it (manifest-verified), flushes the recorder, and reports
+    interrupted — epochs after the boundary never run."""
+    from dba_mod_tpu.fl.experiment import Experiment
+    cfg = dict(CFG, save_model=True, graceful_shutdown=True,
+               run_dir=str(tmp_path / "runs"))
+    e = Experiment(Params.from_dict(cfg), save_results=True)
+    orig = Experiment.save_model
+
+    def save_and_stop(self, epoch, fl=None, async_save=False):
+        orig(self, epoch, fl=fl, async_save=async_save)
+        if epoch >= 2:
+            self.guard.shutdown.request_stop()
+
+    monkeypatch.setattr(Experiment, "save_model", save_and_stop)
+    last = e.run(6)
+    assert e.interrupted
+    assert last["epoch"] == 2  # the boundary honored the stop before 3
+    path = e.folder / "model_last.pt.tar"
+    ok, reason = ckpt.verify_checkpoint(path)
+    assert ok, reason
+    _, saved_epoch, _ = ckpt.load_checkpoint(
+        path, e.model_def.init_vars(jax.random.key(0)))
+    assert saved_epoch == 2
+    # recorder flushed through the boundary
+    rows = (e.folder / "round_result.csv").read_text().strip().splitlines()
+    assert len(rows) - 1 == 2  # header + 2 rounds
+
+
+def test_run_without_guard_has_no_handlers_or_threads(tmp_path):
+    """The acceptance contract: with the knobs at their defaults a run
+    installs no signal handlers and starts no watchdog thread."""
+    from dba_mod_tpu.fl.experiment import Experiment
+    before = {s: signal.getsignal(s) for s in GracefulShutdown.SIGNALS}
+    threads_before = {t.name for t in threading.enumerate()}
+    e = Experiment(Params.from_dict(dict(CFG, epochs=1)), save_results=False)
+    e.run(1)
+    assert not e.interrupted
+    for s, h in before.items():
+        assert signal.getsignal(s) is h
+    assert "dba-watchdog" not in {t.name for t in threading.enumerate()
+                                  } - threads_before
+
+
+# ------------------------------------------------------- config validation
+def test_config_validates_guard_knobs():
+    ok = dict(CFG)
+    Params.from_dict(dict(ok, watchdog_soft_s=5, watchdog_hard_s=30))
+    Params.from_dict(dict(ok, watchdog_soft_s=5, watchdog_hard_s=0))
+    Params.from_dict(dict(ok, resumed_model="auto"))
+    with pytest.raises(ValueError, match="watchdog_hard_s"):
+        Params.from_dict(dict(ok, watchdog_soft_s=30, watchdog_hard_s=5))
+    with pytest.raises(ValueError, match="watchdog"):
+        Params.from_dict(dict(ok, watchdog_soft_s=-1))
+    with pytest.raises(ValueError, match="resumed_model"):
+        Params.from_dict(dict(ok, resumed_model="maybe"))
+    with pytest.raises(ValueError, match="keep_last_n"):
+        Params.from_dict(dict(ok, keep_last_n=-2))
+    # auto-resume only restores manifest-verified snapshots: the
+    # combination that can never resume is a config error, not a
+    # silent fresh start on every relaunch
+    with pytest.raises(ValueError, match="checkpoint_manifests"):
+        Params.from_dict(dict(ok, resumed_model="auto",
+                              checkpoint_manifests=False))
+    assert Params.from_dict(dict(ok, resumed_model="auto")).resume_mode \
+        == "auto"
+    assert Params.from_dict(dict(ok, resumed_model=True)).resume_mode \
+        == "named"
+    assert Params.from_dict(ok).resume_mode == "off"
