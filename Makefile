@@ -2,7 +2,7 @@
 # command: the fast CPU suite (slow-marked rehearsals deselected) on the
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
-.PHONY: tier1 test-slow trace crash-smoke
+.PHONY: tier1 test-slow trace crash-smoke elastic-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -32,3 +32,12 @@ trace:
 # same folder with no duplicate rounds.
 crash-smoke:
 	bash scripts/crash_smoke.sh
+
+# Elastic multi-host drill (README "Elastic multi-host"): 2-process
+# jax.distributed run on virtual CPU devices, SIGKILL one worker mid-run
+# (expects the survivor to exit 77 = EXIT_PEER_LOST with a verified
+# checkpoint, bounded by watchdog_hard_s), relaunch the survivors SHRUNK
+# (1 process) with --resume auto, assert the run completes in the same
+# folder with no duplicate rounds.
+elastic-smoke:
+	bash scripts/elastic_smoke.sh

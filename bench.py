@@ -65,6 +65,112 @@ TINY_CONFIG = dict(
     synthetic_train_size=10000, synthetic_test_size=2000)
 
 
+# --multihost lane (ROADMAP item 5): the 2-process DCN configuration the
+# multi-host tests prove (tests/test_multihost.py) — 2 × 4 virtual CPU
+# devices = one 8-device clients mesh spanning a process boundary — timed
+# end-to-end so the scale-out path has a perf trajectory in the BENCH_*
+# JSON, not just a correctness bit. sync_latency is the host-visible
+# scalar-fetch round trip through the cross-process runtime, the quantity
+# BENCH_r05 tracks single-host.
+MULTIHOST_CONFIG = dict(
+    type="mnist", lr=0.1, batch_size=32, epochs=12, no_models=8,
+    number_of_total_participants=8, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=512, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False,
+    random_seed=1, num_devices=-1)
+
+
+def _multihost_worker(process_id: int, coordinator: str,
+                      timed_rounds: int) -> int:
+    """One process of the 2-process bench world. Env must be set before
+    jax imports, hence the subprocess re-entry."""
+    import os
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(process_id)
+    import jax
+    from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
+    from dba_mod_tpu.config import Params
+    from dba_mod_tpu.fl.experiment import Experiment
+    import jax.numpy as jnp
+
+    exp = Experiment(Params.from_dict(MULTIHOST_CONFIG),
+                     save_results=False)
+    assert jax.process_count() == 2
+    exp.run_round(1)  # compile
+    lat = min(timeit(lambda: jax.device_get(jnp.float32(1.0) + 1))
+              for _ in range(3))
+    t0 = time.perf_counter()
+    pending = None
+    for i in range(2, 2 + timed_rounds):
+        fl = exp.dispatch_round(i)
+        if pending is not None:
+            exp.finalize_round(pending)
+        pending = fl
+    exp.finalize_round(pending)
+    spr = (time.perf_counter() - t0) / timed_rounds
+    if process_id == 0:
+        print(json.dumps({
+            "metric": "multihost_2proc_rounds_per_sec",
+            "value": round(1.0 / spr, 4), "unit": "rounds/sec",
+            "sync_latency_s": round(lat, 4),
+            "world": {"processes": 2, "devices": int(jax.device_count())},
+            "workload": "synthetic mnist, 8 clients/round, 2-process DCN "
+                        "over 2x4 virtual CPU devices "
+                        "(tests/test_multihost.py configuration)"}),
+            flush=True)
+    return 0
+
+
+def measure_multihost(timed_rounds: int) -> dict:
+    """Spawn the 2-process world and collect process 0's JSON line."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    import os
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                        "JAX_COORDINATOR_ADDRESS")}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["TF_CPP_MIN_LOG_LEVEL"] = "3"
+    procs = [subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), "--multihost-worker",
+         str(pid), coord, str(timed_rounds)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(REPO)) for pid in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=1800)[0])
+    except subprocess.TimeoutExpired:
+        # one wedged worker (startup race, gloo hang) must not take the
+        # whole bench down or orphan its sibling — same contract as the
+        # tiny lane: the headline number always prints
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        return {"error": "multihost worker timed out after 1800s; "
+                         "workers killed"}
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            return {"error": f"multihost worker {pid} rc={p.returncode}: "
+                             f"{out[-2000:]}"}
+    for line in reversed(outs[0].strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {"error": f"no JSON line from worker 0: {outs[0][-2000:]}"}
+
+
 def _make_experiment(config=None):
     import jax
     # persistent compile cache: the 5 step-bucket shapes + eval programs
@@ -217,6 +323,10 @@ def baseline_seconds_per_round(skip: bool) -> float | None:
 
 
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--multihost-worker":
+        # subprocess re-entry: env vars must precede jax import
+        return _multihost_worker(int(sys.argv[2]), sys.argv[3],
+                                 int(sys.argv[4]))
     ap = argparse.ArgumentParser()
     # 12 timed rounds: the tunnel's ~0.07-0.16 s sync-latency jitter puts
     # ±3% run-to-run noise on a 5-round measurement; 12 cuts it ~35%
@@ -227,6 +337,12 @@ def main() -> int:
     ap.add_argument("--no-tiny", action="store_true",
                     help="skip the Tiny-ImageNet second lane")
     ap.add_argument("--tiny-rounds", type=int, default=4)
+    ap.add_argument("--multihost", action="store_true",
+                    help="add the 2-process DCN lane (2x4 virtual CPU "
+                         "devices, tests/test_multihost.py configuration): "
+                         "rounds/sec + sync_latency into the JSON under "
+                         "'multihost_lane'")
+    ap.add_argument("--multihost-rounds", type=int, default=8)
     ap.add_argument("--telemetry", metavar="DIR", default="",
                     help="enable the telemetry layer (utils/telemetry.py): "
                          "writes telemetry.jsonl + Chrome-trace trace.json "
@@ -312,6 +428,16 @@ def main() -> int:
                             "ResNet-18 (200 classes)"}
         except Exception as e:  # noqa: BLE001 — the second lane must not
             out["tiny_lane_error"] = str(e)  # break the headline number
+
+    if args.multihost:
+        # scale-out lane: spawns its own 2-process world (a process that
+        # already initialized jax cannot join one), so it must not touch
+        # this process's experiment — and, like the tiny lane, must never
+        # break the headline number
+        try:
+            out["multihost_lane"] = measure_multihost(args.multihost_rounds)
+        except Exception as e:  # noqa: BLE001
+            out["multihost_lane"] = {"error": str(e)}
     print(json.dumps(out))
     return 0
 

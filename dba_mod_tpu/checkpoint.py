@@ -393,18 +393,24 @@ def resolve_verified(path: str | Path) -> Path:
         f"{path.parent}")
 
 
-def find_auto_resume(run_dir: str | Path,
-                     run_type: str) -> Optional[Tuple[Path, Path]]:
+def find_auto_resume(run_dir: str | Path, run_type: str,
+                     run_name: str = "") -> Optional[Tuple[Path, Path]]:
     """``resumed_model: auto``: scan `run_dir` for this workload's run
     folders (``{type}_*``), newest first, and return ``(run_folder,
     checkpoint_path)`` for the newest verified checkpoint — or None when
-    no run folder holds one (fresh start)."""
+    no run folder holds one (fresh start). With a fixed ``run_name``
+    (multi-process / elastic runs share one non-timestamped folder) only
+    that folder is considered — an elastic relaunch must re-enter the
+    killed world's folder, never a stale timestamped sibling."""
     run_dir = Path(run_dir)
     if not run_dir.is_dir():
         return None
-    folders = sorted((p for p in run_dir.glob(f"{run_type}_*")
-                      if p.is_dir()),
-                     key=lambda p: p.stat().st_mtime, reverse=True)
+    if run_name:
+        folders = [p for p in (run_dir / run_name,) if p.is_dir()]
+    else:
+        folders = sorted((p for p in run_dir.glob(f"{run_type}_*")
+                          if p.is_dir()),
+                         key=lambda p: p.stat().st_mtime, reverse=True)
     for folder in folders:
         hit = latest_verified_checkpoint(folder)
         if hit is not None:

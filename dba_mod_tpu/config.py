@@ -137,6 +137,17 @@ _DEFAULTS: Dict[str, Any] = {
     "fault_blowup_prob": 0.0,      # P(payload scaled by blowup factor)
     "fault_blowup_factor": 1e8,    # norm-blowup magnitude
     "fault_stale_prob": 0.0,       # P(client replays last round's delta)
+    "fault_host_loss_prob": 0.0,   # P(the round loses one whole HOST):
+                                   # multi-process runs SIGKILL the victim
+                                   # process at the round boundary (CI for
+                                   # the elastic detect→restart path);
+                                   # single-process runs drop the victim
+                                   # virtual host's client slice through
+                                   # the survivor mask
+    "fault_num_hosts": 0,          # virtual host count for single-process
+                                   # host-loss simulation (>= 2 required
+                                   # when the lane is on); multi-process
+                                   # runs use the real process count
     "screen_updates": "auto",      # server-side delta validation/quarantine
                                    # (finite + norm screen): "auto" = on iff
                                    # fault_injection; true/false to force
@@ -178,6 +189,34 @@ _DEFAULTS: Dict[str, Any] = {
                                    # newest N *.epoch_N snapshots
                                    # (model_last and .best always kept);
                                    # 0 = keep all
+    # --- elastic multi-host (parallel/distributed.py::PeerHealth;
+    #     README "Elastic multi-host"). All strict no-ops single-host or
+    #     when heartbeat_interval_s is 0: no thread, no files, no
+    #     per-round work.
+    "heartbeat_interval_s": 0.0,   # per-host heartbeat cadence in a
+                                   # multi-process run; 0 = elastic layer
+                                   # off
+    "heartbeat_timeout_s": 0.0,    # heartbeat staleness past this = the
+                                   # peer is GONE (not slow) → exit 77;
+                                   # 0 = 6 × heartbeat_interval_s
+    "heartbeat_barrier_s": 0.0,    # bounded round-boundary barrier: wait
+                                   # up to this long for every peer to
+                                   # reach the boundary (timeout = slow
+                                   # peer, proceed; stale = PeerLost);
+                                   # 0 = non-blocking staleness check only
+    "heartbeat_dir": "",           # shared dir for heartbeat files; "" =
+                                   # <run_folder>/_peers (per-run — twin
+                                   # worlds in one run_dir must not read
+                                   # each other's beats), or
+                                   # <run_dir>/_peers when the run saves
+                                   # no results. Must be on a filesystem
+                                   # every host can reach.
+    "run_name": "",                # fixed run-folder name (run_dir/
+                                   # run_name) instead of the timestamped
+                                   # default — REQUIRED for multi-process
+                                   # runs that save results/checkpoints,
+                                   # so every process and every elastic
+                                   # relaunch agrees on one folder
 }
 
 
@@ -237,6 +276,19 @@ class Params:
                 f"({soft}) — the soft diagnostic must fire before the abort")
         if int(merged["keep_last_n"]) < 0:
             raise ValueError("keep_last_n must be >= 0")
+        hb = float(merged["heartbeat_interval_s"])
+        hb_to = float(merged["heartbeat_timeout_s"])
+        hb_bar = float(merged["heartbeat_barrier_s"])
+        if hb < 0 or hb_to < 0 or hb_bar < 0:
+            raise ValueError("heartbeat_interval_s/heartbeat_timeout_s/"
+                             "heartbeat_barrier_s must be >= 0")
+        if 0 < hb_to <= hb:
+            raise ValueError(
+                f"heartbeat_timeout_s ({hb_to}) must exceed "
+                f"heartbeat_interval_s ({hb}) — a peer must get at least "
+                "one beat window before being declared gone")
+        if int(merged["fault_num_hosts"]) < 0:
+            raise ValueError("fault_num_hosts must be >= 0")
         return cls(raw=merged)
 
     # ------------------------------------------------------------- dict access
@@ -366,8 +418,17 @@ class Params:
         with open(Path(folder) / "params.yaml", "w") as f:
             yaml.dump(self.raw, f)
 
+    @property
+    def run_name(self) -> str:
+        """Fixed run-folder name ('' = timestamped default). Multi-process
+        runs that save results must set it: every process — and every
+        elastic relaunch of the survivors — has to agree on ONE folder,
+        which per-process timestamps cannot guarantee."""
+        return str(self.raw.get("run_name", "") or "")
+
     def make_run_folder(self) -> Path:
-        folder = Path(self.raw["run_dir"]) / f"{self.type}_{self.current_time}"
+        name = self.run_name or f"{self.type}_{self.current_time}"
+        folder = Path(self.raw["run_dir"]) / name
         folder.mkdir(parents=True, exist_ok=True)
         self.write_yaml(folder)
         return folder

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import random
+import signal
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -95,6 +97,12 @@ class RoundInFlight:
     vars_after: Any = None       # global ModelVars after this round
     fg_after: Any = None         # FoolsGoldState after this round
     rng_after: Optional[Dict[str, Any]] = None
+    # the deltas the server RECEIVED this round — the stale fault lane's
+    # replay source for the NEXT round, captured per-round for the resume
+    # sidecar (under pipelining the live _prev_deltas may already belong
+    # to round N+1 when round N checkpoints). None unless the stale lane
+    # is on.
+    deltas_after: Any = None
 
 
 class Experiment:
@@ -116,29 +124,45 @@ class Experiment:
         # timestamped dir
         self._auto_resume_path: Optional[Path] = None
         resumed_folder: Optional[Path] = None
+        # one results writer per multi-process run: every process shares
+        # the run folder path (orbax checkpoint saves are collective — all
+        # processes must call with the same path), but only process 0
+        # writes run metadata, logs, and the recorder streams
+        is_writer = jax.process_index() == 0
+        if (save_results and jax.process_count() > 1
+                and not params.run_name):
+            raise ValueError(
+                "multi-process runs that save results require run_name: "
+                "every process — and every elastic relaunch of the "
+                "survivors — must agree on ONE run folder, which "
+                "per-process timestamped folders cannot guarantee")
         if params.resume_mode == "auto":
             hit = ckpt.find_auto_resume(Path(str(params["run_dir"])),
-                                        params.type)
+                                        params.type, params.run_name)
             if hit is not None:
                 resumed_folder, self._auto_resume_path = hit
         if not save_results:
             self.folder: Optional[Path] = None
         elif resumed_folder is not None:
             self.folder = resumed_folder
-            ckpt.sweep_stale(self.folder)  # crash debris: *.tmp, orbax tmp
-            params.write_yaml(self.folder)
-        else:
+            if is_writer:  # exclusive-owner mutations: one process only
+                ckpt.sweep_stale(self.folder)  # debris: *.tmp, orbax tmp
+                params.write_yaml(self.folder)
+        elif is_writer:
             self.folder = params.make_run_folder()
+        else:
+            self.folder = Path(str(params["run_dir"])) / params.run_name
+            self.folder.mkdir(parents=True, exist_ok=True)
         # idempotent logger setup (telemetry.py): one stream handler, one
         # run-folder file handler that FOLLOWS the active experiment —
         # replaces the old basicConfig + per-instance FileHandler stacking
         # (two experiments in one process each logged every line twice)
-        telemetry.setup_logging(self.folder)
-        if self.folder:
+        telemetry.setup_logging(self.folder if is_writer else None)
+        if self.folder and is_writer:
             from dba_mod_tpu.utils.html import dict_html
             (self.folder / "params.html").write_text(
                 dict_html(params.raw, params.current_time))
-        self.recorder = Recorder(self.folder,
+        self.recorder = Recorder(self.folder if is_writer else None,
                                  tensorboard=bool(params.get("tensorboard")))
         # telemetry (utils/telemetry.py): spans + metrics + XLA compile and
         # memory instrumentation. Files land in telemetry_dir (default: the
@@ -250,6 +274,32 @@ class Experiment:
             from dba_mod_tpu.parallel.mesh import make_mesh
             self.mesh = make_mesh(0 if nd == -1 else nd)
 
+        # elastic peer-health layer (parallel/distributed.py::PeerHealth):
+        # per-host heartbeats, round-boundary staleness checks, and the
+        # peer-lost watchdog verdict (exit 77). Active only in
+        # multi-process runs with heartbeat_interval_s > 0 — single-host
+        # the knobs are strict no-ops: no thread, no files, no per-round
+        # work (run() never touches a None peers).
+        self.peers = None
+        self.heartbeat_barrier_s = float(
+            params.get("heartbeat_barrier_s", 0.0))
+        hb = float(params.get("heartbeat_interval_s", 0.0))
+        if hb > 0 and jax.process_count() > 1:
+            from dba_mod_tpu.parallel.distributed import PeerHealth
+            # default under THIS run's folder: concurrent runs sharing a
+            # run_dir must not read each other's heartbeats (a same-gen
+            # twin world would mask a real loss); folder-less runs
+            # (save_results=False) fall back to run_dir/_peers
+            hb_dir = (str(params.get("heartbeat_dir", "") or "")
+                      or str((self.folder if self.folder is not None
+                              else Path(str(params["run_dir"])))
+                             / "_peers"))
+            self.peers = PeerHealth(
+                hb_dir, jax.process_index(), jax.process_count(),
+                interval_s=hb,
+                timeout_s=float(params.get("heartbeat_timeout_s", 0.0)))
+        self.telemetry.gauge("mesh/world_size").set(jax.process_count())
+
         self.interval = int(params["aggr_epoch_interval"])
         self.sequential_debug = bool(params.get("sequential_debug", False))
         if self.sequential_debug and self.mesh is not None:
@@ -277,9 +327,10 @@ class Experiment:
         self.retry_backoff_s = float(params.get("retry_backoff_s", 0.0))
         self._fault_key = jax.random.key(self.engine.fault_cfg.seed)
         # last round's submitted deltas (the stale lane's replay source).
-        # Deliberately NOT in the resume sidecar (it is model-sized × C):
-        # a resumed run's first stale replay falls back to zeros — fault
-        # PLANS still reproduce exactly (pure f(fault_seed, epoch))
+        # Checkpointed in the aux sidecar when the lane is on (save_model
+        # captures each round's deltas_after), so a resumed run's first
+        # stale replay is faithful; only sidecar-less resumes (pretrain /
+        # model-only checkpoints) fall back to the zero delta here.
         self._prev_deltas = None
         grad_len = int(np.prod(
             self.model_def.similarity_param(self.global_vars.params).shape))
@@ -341,6 +392,15 @@ class Experiment:
         if self.mesh is not None:
             from dba_mod_tpu.parallel.mesh import replicate_for_mesh
             self.fg_state = replicate_for_mesh(self.mesh, self.fg_state)
+        pd = aux.get("prev_deltas")
+        if pd is not None and self.engine.fault_cfg.stale_enabled:
+            # faithful first post-resume stale replay (the lane is
+            # single-process-only, so plain placement suffices)
+            tree = jax.tree_util.tree_map(jnp.asarray, pd)
+            if self.mesh is not None:
+                from dba_mod_tpu.parallel.mesh import client_sharding
+                tree = jax.device_put(tree, client_sharding(self.mesh))
+            self._prev_deltas = tree
 
     # ------------------------------------------------------------------ data
     def _load_data_and_partition(self, seed: int):
@@ -854,7 +914,8 @@ class Experiment:
             payload = payload[:1] + (globals_dev,) + payload[2:]
         self.global_vars = new_vars
         self.fg_state = new_fg
-        if self.engine.fault_cfg.stale_enabled:
+        stale_on = self.engine.fault_cfg.stale_enabled
+        if stale_on:
             self._prev_deltas = deltas_out
         return RoundInFlight(
             epoch=epoch, t0=t0, seg_epochs=seg_epochs,
@@ -862,7 +923,8 @@ class Experiment:
             tasks_list=tasks_list, mask_list=mask_list, payload=payload,
             n_retries=retries, forced_degraded=forced,
             vars_after=new_vars, fg_after=new_fg,
-            rng_after=self._snapshot_rng())
+            rng_after=self._snapshot_rng(),
+            deltas_after=deltas_out if stale_on else None)
 
     def _snapshot_rng(self) -> Dict[str, Any]:
         """Host snapshot of every RNG stream a round consumes, taken right
@@ -1215,6 +1277,18 @@ class Experiment:
                        "best_loss": float(self.best_loss),
                        "last_backdoor_acc": self.last_backdoor_acc,
                        **rng}
+                if self.engine.fault_cfg.stale_enabled:
+                    # the stale lane's replay source: what the server
+                    # received THIS round (deltas_after under pipelining —
+                    # the live _prev_deltas may already be next round's).
+                    # Model-sized × C, but the lane is single-process-only
+                    # and opt-in; without it the first post-resume stale
+                    # replay would silently replay a zero delta.
+                    src = (fl.deltas_after if fl is not None
+                           else self._prev_deltas)
+                    if src is not None:
+                        aux["prev_deltas"] = jax.tree_util.tree_map(
+                            np.asarray, src)
                 for p in written:
                     ckpt.save_aux_state(p, aux)
             if jax.process_index() == 0:  # one manifest/GC writer
@@ -1227,12 +1301,33 @@ class Experiment:
 
     def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
         self.interrupted = False
+        from dba_mod_tpu.parallel.distributed import PeerLostError
         # the guard context installs the SIGTERM/SIGINT handlers around the
         # run loop (and restores the previous ones after) — a no-op unless
         # graceful_shutdown is on
         with self.guard:
+            if self.peers is not None:
+                # heartbeats + the peer-lost watchdog verdict live exactly
+                # as long as the round loop
+                self.peers.start()
+                self.guard.attach_peer_health(self.peers)
             try:
                 return self._run_rounds(epochs)
+            except PeerLostError:
+                telemetry.count("run/peer_lost")
+                raise
+            except Exception as exc:
+                # classify: a collective that failed because its peer
+                # vanished must surface as PeerLost (exit 77, relaunch
+                # shrunk), not as a generic crash — poll the heartbeats
+                # long enough for a real loss to become stale
+                lost = self._classify_peer_failure()
+                if lost:
+                    telemetry.count("run/peer_lost")
+                    raise PeerLostError(
+                        lost, detail=f"collective failure: "
+                        f"{type(exc).__name__}") from exc
+                raise
             finally:
                 try:
                     # EVERY exit path — normal return, graceful stop, or a
@@ -1242,12 +1337,30 @@ class Experiment:
                     with self.guard.watch("checkpoint/wait_async"):
                         ckpt.wait_for_async_saves()
                 finally:
+                    if self.peers is not None:
+                        self.guard.attach_peer_health(None)
+                        self.peers.stop()
                     # end-of-run telemetry: final trace.json flush + the
                     # printed phase-summary table (p50/p95 per span,
                     # recompile count, peak device memory) — also on a
                     # mid-run exception, so a crashed run still leaves a
                     # loadable trace
                     self._finish_telemetry()
+
+    def _classify_peer_failure(self) -> List[int]:
+        """An exception escaped the round loop: slow peer or gone peer?
+        Poll the heartbeats for up to one timeout window — a dead host's
+        file goes stale within it, a live-but-erroring world's does not.
+        Empty list = not a peer loss (re-raise the original)."""
+        if self.peers is None:
+            return []
+        deadline = (time.monotonic() + self.peers.timeout_s
+                    + self.peers.interval_s)
+        while True:
+            lost = self.peers.lost_peers()
+            if lost or time.monotonic() >= deadline:
+                return lost
+            time.sleep(min(max(self.peers.interval_s, 0.05), 0.25))
 
     def _finish_telemetry(self) -> None:
         t = self.telemetry
@@ -1298,6 +1411,7 @@ class Experiment:
                 if self.guard.stop_requested:
                     self._note_interrupted(epoch)
                     break
+                self._round_boundary(epoch)
                 fl = self.dispatch_round(epoch)
                 if pending is not None:
                     last = finalize_and_log(pending)
@@ -1312,6 +1426,7 @@ class Experiment:
                 # saved — nothing mid-flight to lose
                 self._note_interrupted(epoch)
                 break
+            self._round_boundary(epoch)
             if profile_dir and epoch == self.start_epoch + self.interval:
                 # trace the first post-compile round (SURVEY §5 tracing row)
                 with jax.profiler.trace(profile_dir):
@@ -1324,6 +1439,46 @@ class Experiment:
                         epoch, last["round_time"], last["global_acc"],
                         last["backdoor_acc"])
         return last
+
+    def _round_boundary(self, epoch: int) -> None:
+        """Elastic round-boundary work, in order: (1) the host-loss fault
+        lane may SIGKILL this process (multi-process runs — the designated
+        victim dies HERE, at a boundary, so committed rounds stay
+        committed); (2) beat + peer staleness check, optionally the
+        bounded barrier — a dead peer surfaces as PeerLostError now,
+        outside any collective, instead of a wedged program. No-op when
+        the elastic layer and the host-loss lane are off."""
+        self._maybe_kill_self(epoch)
+        if self.peers is None:
+            return
+        if self.heartbeat_barrier_s > 0:
+            self.peers.barrier(epoch, self.heartbeat_barrier_s)
+        else:
+            self.peers.check(epoch)
+
+    def _maybe_kill_self(self, epoch: int) -> None:
+        """Multi-process enactment of the host-loss fault lane
+        (fl/faults.py::host_loss_victim): every process derives the same
+        per-epoch victim from (fault_seed, epoch); the victim SIGKILLs
+        itself — no handlers, no cleanup, exactly the preemption shape the
+        elastic layer must survive. Single-process runs enact the lane
+        inside the round program instead (host_loss_in_program)."""
+        from dba_mod_tpu.fl import faults as flt
+        fcfg = self.engine.fault_cfg
+        if (not fcfg.host_loss_enabled or fcfg.host_loss_in_program
+                or jax.process_count() == 1):
+            return
+        rng_f = jax.random.fold_in(self._fault_key, epoch)
+        victim = int(flt.host_loss_victim(fcfg, rng_f))
+        if victim != jax.process_index():
+            return
+        logger.critical(
+            "fault injection: host-loss lane kills process %d at the "
+            "epoch-%d boundary (SIGKILL — survivors must detect, exit %d, "
+            "and relaunch shrunk)", victim, epoch,
+            run_guard.EXIT_PEER_LOST)
+        logging.shutdown()
+        os.kill(os.getpid(), signal.SIGKILL)
 
     def _note_interrupted(self, next_epoch: int) -> None:
         """A graceful-stop request was honored at a round boundary: record

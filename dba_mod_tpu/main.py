@@ -9,6 +9,10 @@ Subcommands beyond the reference:
     pretrain   train a clean model and save the checkpoint that attack
                configs resume from (replaces the reference's Google-Drive
                pretrained artifacts, README.md:33-34)
+    fetch      dataset preflight: exact upstream URLs + sha256 checksums
+               for CIFAR/MNIST/Tiny-ImageNet/LOAN, download + verify (or
+               --check-only), with an explicit printout of the synthetic
+               fallback any absent dataset will engage
     cache-tiny decode the Tiny-ImageNet image folders once into an .npz
                cache for fast loading
     loan-etl / tiny-etl   the reference's offline data prep
@@ -17,6 +21,8 @@ Subcommands beyond the reference:
 from __future__ import annotations
 
 import argparse
+import logging
+import os
 import sys
 from pathlib import Path
 
@@ -47,8 +53,23 @@ def _train(args) -> int:
         else:
             params.raw.update(resumed_model=True,
                               resumed_model_name=args.resume)
+    from dba_mod_tpu.parallel.distributed import PeerLostError
     exp = Experiment(params, save_results=not args.no_save)
-    last = exp.run()
+    try:
+        last = exp.run()
+    except PeerLostError as e:
+        # elastic verdict (README "Elastic multi-host"): a peer host is
+        # gone. The run's finally already flushed checkpoints/recorder;
+        # exit with the distinct code so the supervisor relaunches the
+        # SURVIVORS with JAX_NUM_PROCESSES shrunk + --resume auto.
+        # os._exit: the jax.distributed atexit teardown would block on the
+        # dead peer — nothing left to flush is worth that hang.
+        print(f"peer lost: {e} — relaunch the survivors with "
+              f"JAX_NUM_PROCESSES shrunk and --resume auto", flush=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        logging.shutdown()
+        os._exit(run_guard.EXIT_PEER_LOST)
     if exp.interrupted:
         # graceful SIGTERM/SIGINT stop: distinct exit code so run wrappers
         # know to relaunch with --resume auto rather than report failure
@@ -87,6 +108,18 @@ def _pretrain(args) -> int:
     print(f"pretrained to epoch {params['epochs']} "
           f"acc={acc if acc is None else round(acc, 2)} -> {out}")
     return 0
+
+
+def _fetch(args) -> int:
+    from dba_mod_tpu.data.fetch import run_preflight
+    data_dir = args.data_dir
+    types = [args.type] if args.type and args.type != "all" else None
+    if args.params:
+        params = Params.from_yaml(args.params)
+        types = [params.type]
+        if args.data_dir == "./data":  # YAML wins unless overridden
+            data_dir = str(params.get("data_dir", "./data"))
+    return run_preflight(types, data_dir, check_only=args.check_only)
 
 
 def _cache_tiny(args) -> int:
@@ -144,6 +177,21 @@ def build_parser() -> argparse.ArgumentParser:
     common(pre)
     pre.add_argument("--out", default=None,
                      help="checkpoint path under saved_models/")
+    fe = sub.add_parser(
+        "fetch", help="dataset preflight: check/download + sha256-verify "
+                      "the real datasets; absent ones fall back to the "
+                      "deterministic synthetic backend at run time")
+    fe.add_argument("--params", default=None,
+                    help="YAML config: preflight exactly the dataset this "
+                         "experiment needs (type + data_dir)")
+    fe.add_argument("--type", default="all",
+                    choices=["all", "cifar", "mnist", "tiny-imagenet-200",
+                             "loan"])
+    fe.add_argument("--data-dir", default="./data")
+    fe.add_argument("--check-only", action="store_true",
+                    help="no network: report presence/integrity and the "
+                         "synthetic-fallback consequences, exit nonzero "
+                         "if anything is missing")
     ct = sub.add_parser("cache-tiny")
     ct.add_argument("--data-dir", default="./data")
     le = sub.add_parser("loan-etl")
@@ -156,11 +204,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    known = {"train", "pretrain", "cache-tiny", "loan-etl", "tiny-etl"}
+    known = {"train", "pretrain", "fetch", "cache-tiny", "loan-etl",
+             "tiny-etl"}
     if argv and argv[0] not in known:
         argv = ["train"] + argv  # reference style: --params only
     args = build_parser().parse_args(argv)
-    return {"train": _train, "pretrain": _pretrain, "cache-tiny": _cache_tiny,
+    return {"train": _train, "pretrain": _pretrain, "fetch": _fetch,
+            "cache-tiny": _cache_tiny,
             "loan-etl": _loan_etl, "tiny-etl": _tiny_etl}[args.cmd](args)
 
 

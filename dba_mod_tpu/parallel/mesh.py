@@ -49,22 +49,35 @@ def segment_client_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, CLIENTS_AXIS))
 
 
+def local_slice_bounds(sharding: NamedSharding, shape,
+                       clients_axis: int) -> "tuple[int, int]":
+    """[lo, hi) bounds of this process's addressable slice of the clients
+    axis for an array of `shape` under `sharding`. The contiguous union of
+    the per-device slices GSPMD assigns this host — also the partition the
+    host-loss fault lane mirrors (fl/faults.py::host_of_lane). Handles
+    shrunk worlds where the surviving device count no longer divides the
+    padded client count (XLA leaves the trailing devices short slices or
+    `None` stops)."""
+    index_map = sharding.addressable_devices_indices_map(tuple(shape))
+    bounds = [(sl[clients_axis].start or 0,
+               sl[clients_axis].stop if sl[clients_axis].stop is not None
+               else shape[clients_axis]) for sl in index_map.values()]
+    return (min(b[0] for b in bounds), max(b[1] for b in bounds))
+
+
 def _place(t, sharding: NamedSharding, clients_axis: int):
     """Single-controller: plain device_put. Multi-process (DCN): every host
     holds the full host-side plan (selection/plan RNGs are seeded
     identically on all hosts), and hands ONLY its addressable slice of the
     clients axis to `jax.make_array_from_process_local_data` — the per-host
     input-placement pattern for multi-host SPMD (device_put cannot target
-    non-addressable devices)."""
+    non-addressable devices). After an elastic shrink the relaunched world
+    simply recomputes these bounds over the surviving devices — the lost
+    host's cohort re-enters through this re-sharding, no special case."""
     if jax.process_count() == 1:
         return jax.device_put(t, sharding)
     t = np.asarray(t)
-    index_map = sharding.addressable_devices_indices_map(t.shape)
-    bounds = [(sl[clients_axis].start or 0,
-               sl[clients_axis].stop if sl[clients_axis].stop is not None
-               else t.shape[clients_axis]) for sl in index_map.values()]
-    lo = min(b[0] for b in bounds)
-    hi = max(b[1] for b in bounds)
+    lo, hi = local_slice_bounds(sharding, t.shape, clients_axis)
     local = t[(slice(None),) * clients_axis + (slice(lo, hi),)]
     return jax.make_array_from_process_local_data(sharding, local, t.shape)
 
@@ -93,7 +106,10 @@ def replicate_for_mesh(mesh: Mesh, tree: Any) -> Any:
 
 
 def pad_clients(n_clients: int, mesh: Optional[Mesh]) -> int:
-    """Smallest padded client count that tiles the mesh."""
+    """Smallest padded client count that tiles the mesh. On an elastic
+    shrink the relaunched (smaller) mesh re-pads from scratch — the
+    padding is a property of the CURRENT world, never carried over from
+    the world that lost a host."""
     if mesh is None:
         return n_clients
     d = mesh.devices.size

@@ -39,15 +39,48 @@ from dba_mod_tpu.utils import telemetry
 
 logger = logging.getLogger("dba_mod_tpu")
 
-# Distinct exit codes so run wrappers (k8s, slurm, the crash-smoke harness)
-# can tell the exit shapes apart without parsing logs. 75/76 follow the
-# sysexits.h convention of "temporary failure — retrying is the fix".
+# Distinct exit codes so run wrappers (k8s, slurm, the crash/elastic smoke
+# harnesses) can tell the exit shapes apart without parsing logs. 75/76/77
+# follow the sysexits.h convention of "temporary failure — retrying is the
+# fix"; 77 additionally tells the wrapper the retry must SHRINK the world.
 EXIT_INTERRUPTED = 75   # graceful stop after SIGTERM/SIGINT; resume-able
 EXIT_WATCHDOG = 76      # watchdog hard abort: a sync point stalled past
                         # watchdog_hard_s; the last committed checkpoint
                         # is the resume point
+EXIT_PEER_LOST = 77     # a peer host is gone (stall coincides with missed
+                        # heartbeats, or the round-boundary check found a
+                        # stale peer): relaunch the SURVIVORS with
+                        # JAX_NUM_PROCESSES shrunk and --resume auto
+                        # (README "Elastic multi-host")
 
 _NULL_CM = contextlib.nullcontext()
+
+
+def _flush_checkpoints_bounded(timeout_s: float = 10.0) -> None:
+    """Best-effort landing of in-flight async checkpoint commits before an
+    abort exit. Bounded: the abort path must never trade a wedged round
+    for a wedged flush (an async commit whose collective peer died would
+    block forever), so the wait runs on a side thread and is abandoned at
+    the deadline — the previous round's manifest-verified snapshot is
+    already on disk either way (checkpoint.py flushes async manifests
+    every round)."""
+    done = threading.Event()
+
+    def _wait():
+        try:
+            from dba_mod_tpu import checkpoint as ckpt  # lazy: no cycle
+            ckpt.wait_for_async_saves()
+        except Exception:  # noqa: BLE001 — aborting anyway
+            pass
+        finally:
+            done.set()
+
+    threading.Thread(target=_wait, daemon=True,
+                     name="dba-abort-flush").start()
+    if not done.wait(timeout_s):
+        logger.warning("abort: async checkpoint flush did not finish in "
+                       "%.0fs — exiting on the previous verified snapshot",
+                       timeout_s)
 
 
 class GracefulShutdown:
@@ -164,6 +197,17 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self.soft_stalls = 0
         self.hard_aborts = 0
+        # elastic verdict hook (parallel/distributed.py::PeerHealth
+        # .lost_peers): when set, a hard stall that coincides with missed
+        # peer heartbeats is classified as "peer gone" and the abort exits
+        # EXIT_PEER_LOST instead of EXIT_WATCHDOG — the supervisor then
+        # relaunches shrunk rather than same-size
+        self.peer_probe: Optional[Callable[[], List[int]]] = None
+        # the verdict the hard-abort path logged — _default_abort reuses
+        # it so the logged code, the run/peer_lost counter, and the real
+        # exit code can never disagree (a peer crossing the staleness
+        # threshold between two probes would otherwise split them)
+        self._verdict: Optional["tuple[int, List[int]]"] = None
 
     @contextlib.contextmanager
     def zone(self, label: str):
@@ -238,22 +282,48 @@ class Watchdog:
                         continue
                     self.hard_aborts += 1
                     telemetry.count("watchdog/hard_aborts")
+                    code, lost = self._verdict = self.abort_verdict()
+                    if lost:
+                        telemetry.count("run/peer_lost")
                     logger.critical(
                         "watchdog: %s stalled past the hard limit (%.1fs > "
-                        "%.1fs) — epoch=%s span stack at entry=%s; aborting "
-                        "with exit code %d (the last committed checkpoint "
-                        "is the resume point)", z.label, elapsed,
+                        "%.1fs) — epoch=%s span stack at entry=%s; %s"
+                        "aborting with exit code %d (the last committed "
+                        "checkpoint is the resume point)", z.label, elapsed,
                         self.hard_s, z.epoch, z.spans or ["-"],
-                        EXIT_WATCHDOG)
+                        (f"stall coincides with missed heartbeats from "
+                         f"peer(s) {lost} — peer lost, relaunch the "
+                         f"survivors shrunk; " if lost else ""),
+                        code)
                     self._on_hard()
                     # an injected on_hard (tests) returns — drop the zone
                     # so the abort doesn't re-fire every poll
                     self._zone = None
 
-    @staticmethod
-    def _default_abort() -> None:  # pragma: no cover — kills the process
+    def abort_verdict(self) -> "tuple[int, List[int]]":
+        """Classify the hard stall: (exit code, lost peer ids). A stall
+        with missed peer heartbeats is a peer loss (EXIT_PEER_LOST) — the
+        survivor is wedged in a collective whose peer vanished, and only a
+        shrunk relaunch can make progress; anything else is the generic
+        wedged-runtime abort (EXIT_WATCHDOG). A probe failure never masks
+        the abort itself."""
+        lost: List[int] = []
+        if self.peer_probe is not None:
+            try:
+                lost = list(self.peer_probe())
+            except Exception:  # noqa: BLE001 — the verdict is best-effort
+                lost = []
+        if lost:
+            return EXIT_PEER_LOST, lost
+        return EXIT_WATCHDOG, lost
+
+    def _default_abort(self) -> None:  # pragma: no cover — kills the process
+        # reuse the verdict _loop just logged/counted; probe fresh only if
+        # an injected caller reached here without one
+        code, _ = self._verdict or self.abort_verdict()
+        _flush_checkpoints_bounded()
         logging.shutdown()
-        os._exit(EXIT_WATCHDOG)
+        os._exit(code)
 
 
 class RunGuard:
@@ -279,6 +349,14 @@ class RunGuard:
     @property
     def stop_requested(self) -> bool:
         return self.shutdown.stop_requested
+
+    def attach_peer_health(self, peers) -> None:
+        """Wire the elastic peer-health layer into the watchdog verdict:
+        a hard stall that coincides with missed heartbeats exits
+        EXIT_PEER_LOST (77) instead of EXIT_WATCHDOG (76). `peers` is a
+        PeerHealth (parallel/distributed.py) or None to detach."""
+        self.watchdog.peer_probe = (peers.lost_peers
+                                    if peers is not None else None)
 
     def watch(self, label: str):
         """Watchdog zone around a host-blocking sync point; the shared

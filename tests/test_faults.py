@@ -313,6 +313,38 @@ def test_stale_replay_of_zero_history_is_identity():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_stale_replay_source_survives_resume(tmp_path):
+    """PR-6 satellite: the stale lane's replay source (last submitted
+    deltas) rides the aux sidecar, so a killed-and-resumed run's first
+    stale replay is faithful — previously it silently replayed zeros."""
+    # stale_prob 0.5, not 1.0: with EVERY client replaying, the history
+    # is zeros forever (round 1 replays the empty history) and the test
+    # could not tell a faithful restore from the old zero fallback
+    cfg = dict(BASE, epochs=4, fault_injection=True, fault_stale_prob=0.5,
+               save_model=True, run_dir=str(tmp_path / "runs"),
+               resumed_model="auto")
+    e1 = Experiment(Params.from_dict(cfg))
+    e1.run(epochs=2)  # rounds 1-2; checkpoint at 2 carries round-2 deltas
+    want = jax.device_get(e1._prev_deltas)
+    assert want is not None
+
+    # fresh process stand-in: auto-resume from the same run_dir
+    e2 = Experiment(Params.from_dict(cfg))
+    assert e2.start_epoch == 3
+    got = e2._prev_deltas
+    assert got is not None, "replay source was not restored from the aux"
+    for a, b in zip(jax.tree_util.tree_leaves(want),
+                    jax.tree_util.tree_leaves(jax.device_get(got))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # some round-2 client actually submitted something non-zero, so the
+    # faithful replay is distinguishable from the old zero fallback
+    assert any(np.abs(np.asarray(l)).sum() > 0
+               for l in jax.tree_util.tree_leaves(want))
+    # and the resumed run keeps training on the restored history
+    r = e2.run_round(3)
+    assert np.isfinite(r["global_acc"])
+
+
 def _corrupting_round_fn(real_fn, fail_times):
     """Wrap the engine's round program: the first `fail_times` invocations
     return a NaN global model with global_finite=False — a deterministic

@@ -1,0 +1,138 @@
+"""parallel/mesh.py + parallel/distributed.py edge cases (PR 6 satellite):
+pad_clients on shrunk meshes, local_slice_bounds when the surviving world
+no longer divides the client count, and initialize_distributed
+idempotency / env-var precedence."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dba_mod_tpu.parallel import distributed
+from dba_mod_tpu.parallel.mesh import (CLIENTS_AXIS, client_sharding,
+                                       local_slice_bounds, make_mesh,
+                                       pad_clients,
+                                       segment_client_sharding)
+
+
+# ------------------------------------------------------------ pad_clients
+def test_pad_clients_tiles_every_shrunk_mesh_size():
+    """An elastic shrink rebuilds the mesh over fewer devices; padding is
+    a property of the CURRENT world for every size it can shrink to."""
+    assert jax.device_count() >= 8, "conftest must provide 8 CPU devices"
+    for d in (1, 2, 3, 4, 5, 6, 7, 8):
+        mesh = make_mesh(d)
+        for c in (1, 5, 8, 10, 100):
+            padded = pad_clients(c, mesh)
+            assert padded >= c
+            assert padded % d == 0
+            assert padded - c < d          # smallest such padding
+    assert pad_clients(10, None) == 10     # no mesh: no padding
+
+
+# ------------------------------------------------------ local_slice_bounds
+@pytest.mark.parametrize("ndev,c", [(8, 16), (4, 16), (8, 8), (2, 6),
+                                    (4, 12)])
+def test_local_slice_bounds_cover_whole_axis_single_process(ndev, c):
+    """Single-process worlds address every device: bounds must span the
+    full clients axis, for stacked ([I, C, ...]) and flat ([C]) layouts."""
+    mesh = make_mesh(ndev)
+    assert local_slice_bounds(client_sharding(mesh), (c, 3), 0) == (0, c)
+    assert local_slice_bounds(segment_client_sharding(mesh),
+                              (2, c, 5), 1) == (0, c)
+
+
+def test_local_slice_bounds_per_device_partition_non_dividing():
+    """The per-device slices under a world that does not divide the padded
+    client count evenly must still tile [0, C) without gaps or overlaps —
+    the property the shrunk relaunch's re-sharding relies on."""
+    mesh = make_mesh(8)
+    c = pad_clients(10, mesh)   # 16 over 8 devices
+    sharding = client_sharding(mesh)
+    index_map = sharding.addressable_devices_indices_map((c, 4))
+    slices = sorted((sl[0].start or 0,
+                     sl[0].stop if sl[0].stop is not None else c)
+                    for sl in index_map.values())
+    assert slices[0][0] == 0 and slices[-1][1] == c
+    for (a_lo, a_hi), (b_lo, b_hi) in zip(slices, slices[1:]):
+        assert a_hi == b_lo                # contiguous, no overlap
+    # shrunk mesh (3 devices) with a count the world doesn't divide
+    mesh3 = make_mesh(3)
+    c3 = pad_clients(10, mesh3)            # 12 over 3 devices
+    assert local_slice_bounds(client_sharding(mesh3), (c3,), 0) == (0, c3)
+
+
+def test_local_slice_bounds_handles_none_stops():
+    """GSPMD emits slice(None) stops for trailing full slices; the bounds
+    math must fall back to the axis length, not crash or shrink."""
+    mesh = make_mesh(1)
+    sharding = NamedSharding(mesh, P(CLIENTS_AXIS))
+    lo, hi = local_slice_bounds(sharding, (7, 2), 0)
+    assert (lo, hi) == (0, 7)
+
+
+# ------------------------------------------- initialize_distributed
+@pytest.fixture
+def _clean_distributed(monkeypatch):
+    """Isolate the module's init guard and env from the suite."""
+    monkeypatch.setattr(distributed, "_initialized", False)
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    calls = []
+
+    class FakeDistributed:
+        @staticmethod
+        def initialize(coordinator_address=None, num_processes=None,
+                       process_id=None):
+            calls.append(dict(coordinator_address=coordinator_address,
+                              num_processes=num_processes,
+                              process_id=process_id))
+
+    monkeypatch.setattr(distributed.jax, "distributed", FakeDistributed)
+    return calls
+
+
+def test_initialize_distributed_noop_without_env(_clean_distributed):
+    assert distributed.initialize_distributed() is False
+    assert _clean_distributed == []
+    assert distributed._initialized is False
+
+
+def test_initialize_distributed_idempotent(_clean_distributed,
+                                           monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setattr(distributed.jax, "process_count", lambda: 2)
+    distributed.initialize_distributed()
+    distributed.initialize_distributed()   # second call: no re-init
+    distributed.initialize_distributed()
+    assert len(_clean_distributed) == 1
+    call = _clean_distributed[0]
+    assert call["coordinator_address"] == "127.0.0.1:1234"
+    assert call["num_processes"] == 2 and call["process_id"] == 0
+
+
+def test_initialize_distributed_explicit_args_beat_env(_clean_distributed,
+                                                       monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1111")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    monkeypatch.setattr(distributed.jax, "process_count", lambda: 2)
+    distributed.initialize_distributed("10.0.0.1:2222", 2, 1)
+    call = _clean_distributed[0]
+    assert call["coordinator_address"] == "10.0.0.1:2222"
+    assert call["num_processes"] == 2 and call["process_id"] == 1
+
+
+def test_initialize_distributed_env_only_partial(_clean_distributed,
+                                                 monkeypatch):
+    """Coordinator set but no process vars: cloud auto-detection path —
+    None num_processes/process_id forwarded for jax to resolve."""
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:9999")
+    monkeypatch.setattr(distributed.jax, "process_count", lambda: 2)
+    distributed.initialize_distributed()
+    call = _clean_distributed[0]
+    assert call["coordinator_address"] == "127.0.0.1:9999"
+    assert call["num_processes"] is None and call["process_id"] is None
