@@ -2,7 +2,7 @@
 # command: the fast CPU suite (slow-marked rehearsals deselected) on the
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
-.PHONY: tier1 test-slow trace crash-smoke elastic-smoke
+.PHONY: tier1 test-slow trace crash-smoke elastic-smoke forensics-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -41,3 +41,11 @@ crash-smoke:
 # folder with no duplicate rounds.
 elastic-smoke:
 	bash scripts/elastic_smoke.sh
+
+# Defense-forensics drill (README "Defense forensics"): tiny FoolsGold
+# sybil run with `forensics: true`, assert forensics.jsonl +
+# client_forensics.csv stream into the run folder with the pinned schema,
+# and render + sanity-check the standalone HTML round-audit via the
+# `report` subcommand.
+forensics-smoke:
+	bash scripts/forensics_smoke.sh

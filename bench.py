@@ -21,6 +21,8 @@ backend, cached in BENCH_FLOPS.json; padding-step compute excluded) by the
 phase time × the chip's bf16 peak.
 
 Usage: python bench.py [--rounds N] [--skip-baseline] [--no-phases]
+Opt-in lanes (each appends a sub-object to the JSON, never breaks the
+headline): --multihost, --poison-cost, --width, --forensics-cost.
 """
 from __future__ import annotations
 
@@ -51,6 +53,29 @@ BENCH_CONFIG = dict(
     # fully-masked no-ops); round pipelining (recording lags one round)
     compute_dtype="bfloat16", eval_batch_size=2048,
     dynamic_steps=True, pipeline_rounds=True)
+
+
+# --poison-cost lane (VERDICT Weak #5): the SAME headline workload with the
+# distributed backdoor on — 4 scheduled adversaries (the cifar_params.yaml
+# stripe geometry), poisoning every timed round, scale_weights 1 so the
+# model trajectory stays numerically tame — vs the benign headline. The
+# delta isolates what the attack path costs end-to-end: the poison-batch
+# injection inside the train step plus the 4-part local eval battery
+# (clean / poison-pre / poison-post / per-agent trigger) vs benign's
+# clean-only battery.
+POISON_COST_CONFIG = dict(
+    BENCH_CONFIG, is_poison=True,
+    internal_poison_epochs=BENCH_CONFIG["internal_epochs"],
+    poisoning_per_batch=5, poison_label_swap=2, poison_lr=0.05,
+    scale_weights_poison=1.0, alpha_loss=1.0, trigger_num=4,
+    is_random_adversary=False, adversary_list=[0, 1, 2, 3],
+    **{"0_poison_pattern": [[0, 0], [0, 1], [0, 2], [0, 3], [0, 4], [0, 5]],
+       "1_poison_pattern": [[0, 9], [0, 10], [0, 11], [0, 12], [0, 13],
+                            [0, 14]],
+       "2_poison_pattern": [[4, 0], [4, 1], [4, 2], [4, 3], [4, 4], [4, 5]],
+       "3_poison_pattern": [[4, 9], [4, 10], [4, 11], [4, 12], [4, 13],
+                            [4, 14]]},
+    **{f"{i}_poison_epochs": list(range(1, 400)) for i in range(4)})
 
 
 # Second lane (VERDICT r4 ask 7): the Tiny-ImageNet workload — imagenet stem
@@ -305,6 +330,20 @@ def timeit(fn):
     return time.perf_counter() - t0
 
 
+def device_peak_bytes():
+    """Device-memory high-water (bytes) from the runtime's allocator stats.
+    None where the backend publishes none (CPU). NOTE: peak_bytes_in_use is
+    monotone over the PROCESS lifetime — in the width lane below, each
+    point's peak subsumes the smaller configs measured before it, so read
+    the series as a running high-water, exact only at the widest point."""
+    import jax
+    stats = jax.local_devices()[0].memory_stats()
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak else None
+
+
 def baseline_seconds_per_round(skip: bool) -> float | None:
     if CACHE.exists():
         return json.loads(CACHE.read_text())["seconds_per_round"]
@@ -343,6 +382,24 @@ def main() -> int:
                          "rounds/sec + sync_latency into the JSON under "
                          "'multihost_lane'")
     ap.add_argument("--multihost-rounds", type=int, default=8)
+    ap.add_argument("--poison-cost", action="store_true",
+                    help="add the poison-cost lane: the headline workload "
+                         "with the 4-adversary DBA + full 4-part local eval "
+                         "battery on, and the rounds/sec delta vs the "
+                         "benign headline (VERDICT Weak #5)")
+    ap.add_argument("--poison-rounds", type=int, default=8)
+    ap.add_argument("--width", action="store_true",
+                    help="add the width lane: clients*rounds/sec at "
+                         "C = 10/50/100 clients/round with the device "
+                         "memory high-water per point (ROADMAP item 1's "
+                         "measurement half)")
+    ap.add_argument("--width-rounds", type=int, default=4)
+    ap.add_argument("--forensics-cost", action="store_true",
+                    help="add the forensics-cost lane: the headline "
+                         "workload with `forensics: true` and the overhead "
+                         "%% vs the forensics-off headline (the <=5%% "
+                         "acceptance gate)")
+    ap.add_argument("--forensics-rounds", type=int, default=8)
     ap.add_argument("--telemetry", metavar="DIR", default="",
                     help="enable the telemetry layer (utils/telemetry.py): "
                          "writes telemetry.jsonl + Chrome-trace trace.json "
@@ -428,6 +485,67 @@ def main() -> int:
                             "ResNet-18 (200 classes)"}
         except Exception as e:  # noqa: BLE001 — the second lane must not
             out["tiny_lane_error"] = str(e)  # break the headline number
+
+    if args.poison_cost:
+        # poison-cost lane: benign denominator = the headline measurement
+        # above (identical config apart from the attack keys)
+        try:
+            pexp = _make_experiment(POISON_COST_CONFIG)
+            pspr = measure_ours(pexp, args.poison_rounds)
+            out["poison_cost_lane"] = {
+                "metric": "cifar10_poison_round_cost",
+                "benign_rounds_per_sec": round(rounds_per_sec, 4),
+                "poison_rounds_per_sec": round(1.0 / pspr, 4),
+                "poison_overhead_pct": round(
+                    100.0 * (pspr - ours) / ours, 2),
+                "workload": "headline config + 4 scheduled DBA adversaries "
+                            "poisoning every timed round; overhead = poison "
+                            "injection in-train + the 4-part local eval "
+                            "battery vs benign's clean-only battery"}
+        except Exception as e:  # noqa: BLE001 — lanes never break
+            out["poison_cost_lane_error"] = str(e)  # the headline number
+
+    if args.width:
+        # width lane: throughput in clients*rounds/sec vs clients-per-round
+        # (C is the vmapped client axis of the fused round program)
+        try:
+            pts = []
+            for C in (10, 50, 100):
+                wexp = _make_experiment(dict(BENCH_CONFIG, no_models=C))
+                spr = measure_ours(wexp, args.width_rounds)
+                pts.append({
+                    "clients_per_round": C,
+                    "rounds_per_sec": round(1.0 / spr, 4),
+                    "clients_rounds_per_sec": round(C / spr, 4),
+                    "device_peak_bytes": device_peak_bytes()})
+                del wexp
+            out["width_lane"] = {
+                "metric": "clients_rounds_per_sec_vs_width",
+                "points": pts,
+                "note": "device_peak_bytes is the allocator's process-"
+                        "lifetime high-water (monotone across points; "
+                        "null on backends without memory_stats)"}
+        except Exception as e:  # noqa: BLE001
+            out["width_lane_error"] = str(e)
+
+    if args.forensics_cost:
+        # forensics-cost lane: identical workload, forensics on. The writer
+        # stays in-memory (save_results=False), so the measured delta is
+        # the device-side ForensicStats computation + the bigger fetch +
+        # host row assembly — the acceptance gate is <= 5%.
+        try:
+            fexp = _make_experiment(dict(BENCH_CONFIG, forensics=True))
+            fspr = measure_ours(fexp, args.forensics_rounds)
+            out["forensics_cost_lane"] = {
+                "metric": "cifar10_forensics_overhead",
+                "off_rounds_per_sec": round(rounds_per_sec, 4),
+                "on_rounds_per_sec": round(1.0 / fspr, 4),
+                "overhead_pct": round(100.0 * (fspr - ours) / ours, 2),
+                "note": "forensics rows assembled in-memory (bench runs "
+                        "with save_results off); file I/O is atomic full "
+                        "rewrites on real runs"}
+        except Exception as e:  # noqa: BLE001
+            out["forensics_cost_lane_error"] = str(e)
 
     if args.multihost:
         # scale-out lane: spawns its own 2-process world (a process that

@@ -100,6 +100,21 @@ _DEFAULTS: Dict[str, Any] = {
     "telemetry_dir": "",           # where telemetry files land; "" = the
                                    # run folder (in-memory only when the
                                    # run saves no results)
+    "forensics": False,            # defense-forensics layer
+                                   # (utils/forensics.py): per-client
+                                   # aggregation diagnostics — delta/received
+                                   # norms, cosine to the applied update,
+                                   # screening verdict + quarantine reason,
+                                   # FoolsGold/RFA weights and similarities,
+                                   # poison-battery accuracy — ride the
+                                   # round payload's single fetch and stream
+                                   # to forensics.jsonl +
+                                   # client_forensics.csv (TensorBoard
+                                   # mirror under forensics/ when
+                                   # tensorboard is on); `report` renders
+                                   # the HTML round-audit. Off = strict
+                                   # no-op: nothing traced, no files,
+                                   # bit-identical recorded metrics
     "sequential_debug": False,     # run clients one-by-one (A/B vs vmapped)
     "data_dir": "./data",
     "synthetic_data": False,       # force the synthetic dataset backend
@@ -289,6 +304,9 @@ class Params:
                 "one beat window before being declared gone")
         if int(merged["fault_num_hosts"]) < 0:
             raise ValueError("fault_num_hosts must be >= 0")
+        if not isinstance(merged["forensics"], bool):
+            raise ValueError(
+                f"forensics must be true/false, got {merged['forensics']!r}")
         return cls(raw=merged)
 
     # ------------------------------------------------------------- dict access

@@ -177,6 +177,17 @@ class Experiment:
             enabled=bool(params.get("telemetry", False)), folder=tfolder,
             tb_sink=(self.recorder._scalar
                      if self.recorder._tb is not None else None))
+        # defense forensics (utils/forensics.py): per-client aggregation
+        # introspection streamed from the jitted round's ForensicStats
+        # payload slot. Opt-in and strictly inert when off: no writer, no
+        # files, no extra device work anywhere in the round path.
+        self.forensics_writer = None
+        if bool(params.get("forensics", False)):
+            from dba_mod_tpu.utils.forensics import ForensicsWriter
+            self.forensics_writer = ForensicsWriter(
+                self.folder if is_writer else None,
+                tb_sink=(self.recorder._scalar
+                         if self.recorder._tb is not None else None))
         self.model_def = build_model(params)
         seed = int(params.get("random_seed", 1))
         self.select_rng = random.Random(seed)
@@ -266,6 +277,10 @@ class Experiment:
                     "resume auto: continuing recorder stream in %s "
                     "(%d metrics rows kept through epoch %d)",
                     self.folder, kept, cut)
+                if self.forensics_writer is not None:
+                    # same truncate-and-continue contract for the forensic
+                    # streams — a replayed round must not appear twice
+                    self.forensics_writer.load_from_folder(cut)
 
         # clients mesh: 0 → single-device; -1 → all visible devices; n → n
         nd = int(params.get("num_devices", 0))
@@ -803,6 +818,13 @@ class Experiment:
                 self.global_vars, train.seg_deltas, tasks_seq.scale,
                 tasks_seq.adv_slot)
         globals_dev = self.engine.global_evals_fn(result.new_vars)
+        fstats_dev = None
+        if self.engine.forensic_fn is not None:
+            # must see the PRE-aggregation globals (the cosine baseline is
+            # "applied update" = new - old), so compute before reassignment
+            fstats_dev = self.engine.forensic_fn(
+                self.global_vars, result.new_vars, train.deltas,
+                result.num_oracle_calls)
         self.global_vars = result.new_vars
         self.fg_state = result.new_fg_state
         track = (bool(params.get("vis_train_batch_loss"))
@@ -810,7 +832,7 @@ class Experiment:
         batch_dev = (train.batch_loss, train.batch_dist) if track else None
         payload = (locals_dev, globals_dev, train.metrics, train.delta_norms,
                    result.wv, result.alpha, batch_dev, result.is_updated,
-                   seg_locals_dev, None)
+                   seg_locals_dev, None, fstats_dev)
         return RoundInFlight(epoch=epoch, t0=t0, seg_epochs=seg_epochs,
                              agent_names=agent_names, adv_names=adv_names,
                              tasks_list=tasks_list, mask_list=mask_list,
@@ -942,8 +964,8 @@ class Experiment:
         with self.guard.watch("round/finalize"), \
                 self.telemetry.span("round/finalize"):
             (locals_, globals_, metrics, delta_norms, wv, alpha,
-             batches, is_updated, seg_locals, rstats) = jax.device_get(
-                 fl.payload)
+             batches, is_updated, seg_locals, rstats,
+             fstats) = jax.device_get(fl.payload)
         finalize_time = time.perf_counter() - t_fin
         # perf_counter durations (the old time.time() delta could jump under
         # clock adjustments); under pipeline_rounds round_time spans the
@@ -970,6 +992,9 @@ class Experiment:
                      fl.tasks_list, metrics, locals_, globals_, delta_norms,
                      wv, alpha, times, batches, fl.mask_list, seg_locals,
                      robust)
+        if self.forensics_writer is not None and fstats is not None:
+            self._record_forensics(fl, locals_, delta_norms, wv, alpha,
+                                   fstats, robust)
         self._flush_round_telemetry(fl, robust, delta_norms, times)
         return {"epoch": fl.epoch, "agents": fl.agent_names,
                 "global_acc": float(globals_.clean.acc),
@@ -998,6 +1023,41 @@ class Experiment:
             t.histogram("delta_norm").observe(float(n))
         t.histogram("round_seconds").observe(times["round_time"])
         t.flush_round(fl.epoch)
+
+    def _record_forensics(self, fl: RoundInFlight, locals_, delta_norms,
+                          wv, alpha, fstats, robust) -> None:
+        """One forensic record per round: host-side assembly of the jitted
+        ForensicStats slot plus the identity/defense context only the
+        experiment knows (names, adversary membership, defense weights,
+        poison battery). Arrays are sliced to the real client count —
+        trailing mesh-padding lanes carry no client."""
+        from dba_mod_tpu.fl.rounds import REASON_NAMES
+        params = self.params
+        names = list(fl.agent_names)
+        C = len(names)
+        adv = set(params.adversary_list)
+        pids = np.asarray(fl.tasks_list[0].participant_id)[:C]
+        poison_acc = None
+        if self.is_poison_run and locals_ is not None:
+            poison_acc = np.asarray(locals_.poison_post.acc)[:C]
+        robust_agg = params.aggregation != cfg.AGGR_MEAN
+        self.forensics_writer.add_round(
+            epoch=fl.epoch, aggregation=params.aggregation, names=names,
+            participant_ids=pids,
+            adversary_flags=[int(n in adv) for n in names],
+            delta_norms=np.asarray(delta_norms)[:C],
+            recv_norms=np.asarray(fstats.recv_norms)[:C],
+            cosine=np.asarray(fstats.cosine_to_agg)[:C],
+            verdict=np.asarray(fstats.verdict)[:C],
+            reason_codes=np.asarray(fstats.reason)[:C],
+            reason_names=REASON_NAMES,
+            weights=np.asarray(wv)[:C] if robust_agg else None,
+            alpha=np.asarray(alpha)[:C] if robust_agg else None,
+            poison_acc=poison_acc,
+            oracle_calls=int(fstats.oracle_calls),
+            n_retries=int(robust.get("n_retries", 0)),
+            degraded=bool(robust.get("degraded", False)))
+        self.forensics_writer.save()
 
     def _train_sequential(self, tasks_seq, idx_seq, mask_seq, rng):
         """Sequential debug mode (SURVEY §7.2.4): run clients one at a time

@@ -102,6 +102,55 @@ class RobustStats(NamedTuple):
     survivor_mask: jax.Array  # [C] bool
 
 
+class ForensicStats(NamedTuple):
+    """Per-client defense-forensics diagnostics, computed inside the jitted
+    round program when `forensics: true` (None in the payload otherwise).
+    Rides the payload's single device_get at finalize — no host callbacks
+    inside jit, no extra sync."""
+    recv_norms: jax.Array     # [C] ‖Δ_params‖ as RECEIVED by the server
+                              # (post fault injection; equals delta_norms
+                              # when the fault layer is off — NaN/Inf for
+                              # corrupted payloads, honestly)
+    cosine_to_agg: jax.Array  # [C] cos(received Δ_c, applied global update)
+    verdict: jax.Array        # [C] bool — client entered the aggregate
+    reason: jax.Array         # [C] i32 quarantine reason (REASON_*)
+    oracle_calls: jax.Array   # i32 — RFA Weiszfeld oracle count (1 else)
+
+
+# quarantine-reason codes carried in ForensicStats.reason
+REASON_OK = 0           # aggregated
+REASON_DROPPED = 1      # never reported (injected dropout)
+REASON_NONFINITE = 2    # failed the finite screen
+REASON_NORM = 3         # exceeded the norm-screen threshold
+REASON_NAMES = {REASON_OK: "ok", REASON_DROPPED: "dropped",
+                REASON_NONFINITE: "nonfinite", REASON_NORM: "norm_exceeded"}
+
+
+def forensic_stats(global_vars: ModelVars, new_vars: ModelVars,
+                   recv_deltas: ModelVars, survivor_mask: jax.Array,
+                   reason: jax.Array, oracle_calls) -> ForensicStats:
+    """Assemble the per-client forensics pytree (jit-traced).
+
+    `recv_deltas` are the deltas the SERVER received (post-fault); the
+    cosine compares each against the update the server actually APPLIED
+    (new - old params), which works uniformly across all three aggregation
+    rules (and yields 0 for a degraded round, where the update is zero).
+    A NaN-corrupted row produces a NaN norm/cosine for that client only —
+    rows are independent, so nothing leaks across clients."""
+    recv_norms = jax.vmap(
+        lambda d: tree_global_norm(d.params))(recv_deltas)
+    pts = agg.flatten_stacked(recv_deltas.params)              # [C, P]
+    upd = agg.flatten_stacked(jax.tree_util.tree_map(
+        lambda n, g: (n - g)[None], new_vars.params,
+        global_vars.params))[0]                                # [P]
+    unorm = jnp.sqrt(jnp.sum(upd * upd))
+    denom = jnp.maximum(recv_norms * unorm, 1e-12)
+    cos = (pts @ upd) / denom
+    return ForensicStats(recv_norms, cos, survivor_mask,
+                         reason.astype(jnp.int32),
+                         jnp.asarray(oracle_calls, jnp.int32))
+
+
 def _per_client_finite(tree: Any) -> jax.Array:
     """[C] bool — every leaf entry of each client's stacked row is finite."""
     flags = None
@@ -216,6 +265,10 @@ class RoundEngine:
                                                    1)))
         self.base_norm_mult = float(params.get("screen_norm_mult", 0.0))
         screening, min_surv = self.screening, self.min_surviving
+        # defense forensics (utils/forensics.py): static flag — when off,
+        # nothing below is traced and the payload keeps a None in the
+        # forensic slot, so the round program is bit-identical to pre-PR
+        self.forensics = forensics_on = bool(params.get("forensics", False))
         # fused per-step updates: pallas multi-tensor kernels; sound only
         # when the clients axis is unsharded (GSPMD cannot partition a
         # custom call), so the mesh path keeps the per-leaf jnp form
@@ -559,7 +612,10 @@ class RoundEngine:
         # sequential_debug and for bench phase diagnostics). Returns
         # (new_vars, new_fg_state, payload) — payload ordered exactly as
         # Experiment.finalize_round unpacks it, with a RobustStats (or None)
-        # in the last slot. The robust variant additionally takes
+        # in slot 9 and a ForensicStats (or None) in the last slot — the
+        # robust dispatch's degraded-path payload surgery slices around
+        # slot 1, so new slots must only ever be APPENDED. The robust
+        # variant additionally takes
         # (rng_f, prev_deltas, norm_mult) and returns the submitted deltas
         # as a 4th output so the next round can replay them for the stale
         # fault lane (an empty tuple when staleness is off).
@@ -577,6 +633,7 @@ class RoundEngine:
             tasks_first = jax.tree_util.tree_map(lambda l: l[0], tasks_seq)
             nbt = nbt_client_deltas(mask_seq, tasks_seq.scale)
             stats = None
+            fstats = None
             deltas_out = ()
             if robust:
                 counted = num_samples > 0
@@ -629,10 +686,37 @@ class RoundEngine:
                 stats = RobustStats(n_dropped, n_quar, n_surv, degraded,
                                     gfin, smask)
                 res = res._replace(new_vars=new_vars, new_fg_state=new_fg)
+                if forensics_on:
+                    # quarantine reason, consistent with the mask actually
+                    # applied: never-reported → dropped; reported but
+                    # screened out → nonfinite or norm_exceeded (screening
+                    # off means smask == reported, so the middle branch is
+                    # unreachable and `finite` is never consulted)
+                    if screening:
+                        finite = _per_client_finite(deltas)
+                        for t in ((fg_grads,) if fg_enabled else ()):
+                            finite = finite & _per_client_finite(t)
+                    else:
+                        finite = jnp.ones_like(smask)
+                    reason = jnp.where(
+                        ~reported, jnp.int32(REASON_DROPPED),
+                        jnp.where(reported & ~smask,
+                                  jnp.where(finite, jnp.int32(REASON_NORM),
+                                            jnp.int32(REASON_NONFINITE)),
+                                  jnp.int32(REASON_OK)))
+                    fstats = forensic_stats(global_vars, new_vars, deltas,
+                                            smask, reason,
+                                            res.num_oracle_calls)
             else:
                 res = aggregate_fn(global_vars, fg_state, deltas, fg_grads,
                                    fg_feature, tasks_first.participant_id,
                                    num_samples, rng_a, nbt)
+                if forensics_on:
+                    C = fg_feature.shape[0]
+                    fstats = forensic_stats(
+                        global_vars, res.new_vars, deltas,
+                        jnp.ones((C,), bool), jnp.zeros((C,), jnp.int32),
+                        res.num_oracle_calls)
             prev = (train.seg_deltas[-1] if num_segments > 1 else
                     jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
             # the local battery evaluates what each client TRAINED (faults
@@ -648,7 +732,7 @@ class RoundEngine:
                           if hyper.track_batches else None)
             payload = (locals_, globals_, train.metrics, train.delta_norms,
                        res.wv, res.alpha, track_pair, res.is_updated, seg_l,
-                       stats)
+                       stats, fstats)
             if robust:
                 return res.new_vars, res.new_fg_state, payload, deltas_out
             return res.new_vars, res.new_fg_state, payload
@@ -690,3 +774,27 @@ class RoundEngine:
         else:
             self.round_fn = jax.jit(round_fn_robust if self.robust
                                     else round_fn)
+
+        # Split-path forensics (sequential_debug / telemetry's per-phase
+        # dispatch — the robust path is never split): the same ForensicStats
+        # as its own tiny jitted program, called by _finish_split_round with
+        # an all-ones mask (no screening on the split path). None when
+        # forensics is off so the split payload keeps its None slot.
+        def forensic_fn(global_vars: ModelVars, new_vars: ModelVars,
+                        deltas: ModelVars, oracle_calls) -> ForensicStats:
+            C = jax.tree_util.tree_leaves(deltas)[0].shape[0]
+            return forensic_stats(global_vars, new_vars, deltas,
+                                  jnp.ones((C,), bool),
+                                  jnp.zeros((C,), jnp.int32), oracle_calls)
+
+        if not forensics_on:
+            self.forensic_fn = None
+        elif mesh is not None:
+            from dba_mod_tpu.parallel.mesh import (client_sharding,
+                                                   replicated_sharding)
+            rep3 = replicated_sharding(mesh)
+            self.forensic_fn = jax.jit(
+                forensic_fn,
+                in_shardings=(rep3, rep3, client_sharding(mesh), rep3))
+        else:
+            self.forensic_fn = jax.jit(forensic_fn)

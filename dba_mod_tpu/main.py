@@ -17,6 +17,9 @@ Subcommands beyond the reference:
                cache for fast loading
     loan-etl / tiny-etl   the reference's offline data prep
                (utils/loan_preprocess.py, utils/tinyimagenet_reformat.py)
+    report     render a run folder's defense-forensics stream
+               (forensics.jsonl, written when `forensics: true`) into a
+               standalone HTML round-audit
 """
 from __future__ import annotations
 
@@ -153,6 +156,14 @@ def _tiny_etl(args) -> int:
     return 0
 
 
+def _report(args) -> int:
+    from dba_mod_tpu.utils.forensics import write_report
+    out = write_report(Path(args.run),
+                       Path(args.out) if args.out else None)
+    print(f"wrote {out}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="dba_mod_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="cmd")
@@ -199,19 +210,26 @@ def build_parser() -> argparse.ArgumentParser:
     le.add_argument("--data-dir", default="./data")
     te = sub.add_parser("tiny-etl")
     te.add_argument("--data-dir", default="./data")
+    rp = sub.add_parser(
+        "report", help="render forensics.jsonl into a standalone HTML "
+                       "round-audit (requires a run with forensics: true)")
+    rp.add_argument("--run", required=True,
+                    help="run folder containing forensics.jsonl")
+    rp.add_argument("--out", default=None,
+                    help="output path (default: RUN/forensics_report.html)")
     return parser
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     known = {"train", "pretrain", "fetch", "cache-tiny", "loan-etl",
-             "tiny-etl"}
+             "tiny-etl", "report"}
     if argv and argv[0] not in known:
         argv = ["train"] + argv  # reference style: --params only
     args = build_parser().parse_args(argv)
     return {"train": _train, "pretrain": _pretrain, "fetch": _fetch,
-            "cache-tiny": _cache_tiny,
-            "loan-etl": _loan_etl, "tiny-etl": _tiny_etl}[args.cmd](args)
+            "cache-tiny": _cache_tiny, "loan-etl": _loan_etl,
+            "tiny-etl": _tiny_etl, "report": _report}[args.cmd](args)
 
 
 if __name__ == "__main__":
