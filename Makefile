@@ -2,7 +2,8 @@
 # command: the fast CPU suite (slow-marked rehearsals deselected) on the
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
-.PHONY: tier1 test-slow trace crash-smoke elastic-smoke forensics-smoke
+.PHONY: tier1 test-slow trace crash-smoke elastic-smoke forensics-smoke \
+  async-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -41,6 +42,14 @@ crash-smoke:
 # folder with no duplicate rounds.
 elastic-smoke:
 	bash scripts/elastic_smoke.sh
+
+# Buffered-async drill (README "Asynchronous federation"): tiny `mode:
+# async` run (merge every 2 arrivals, straggler tail, staleness weighting),
+# SIGTERM it mid-stream (expects the graceful-stop exit code 75 + the
+# streaming buffer checkpointed in the aux sidecar), `--resume auto`,
+# assert aggregation steps 1..N land exactly once in the same folder.
+async-smoke:
+	bash scripts/async_smoke.sh
 
 # Defense-forensics drill (README "Defense forensics"): tiny FoolsGold
 # sybil run with `forensics: true`, assert forensics.jsonl +

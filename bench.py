@@ -22,7 +22,7 @@ phase time × the chip's bf16 peak.
 
 Usage: python bench.py [--rounds N] [--skip-baseline] [--no-phases]
 Opt-in lanes (each appends a sub-object to the JSON, never breaks the
-headline): --multihost, --poison-cost, --width, --forensics-cost.
+headline): --multihost, --poison-cost, --width, --forensics-cost, --async.
 """
 from __future__ import annotations
 
@@ -88,6 +88,20 @@ POISON_COST_CONFIG = dict(
 TINY_CONFIG = dict(
     BENCH_CONFIG, type="tiny-imagenet-200",
     synthetic_train_size=10000, synthetic_test_size=2000)
+
+
+# --async lane (README "Asynchronous federation"): the headline workload
+# through the buffered-async engine (fl/async_rounds.py) — 10-client
+# cohorts, merge every 5 arrivals, polynomial staleness weighting, a
+# jittered arrival process with a straggler tail. The FedBuff-native
+# throughput unit is sustained client updates absorbed per second
+# (merges/sec × buffer_k); pipeline_rounds is a lockstep-loop knob and is
+# ignored by the streaming driver.
+ASYNC_CONFIG = dict(
+    BENCH_CONFIG, mode="async", buffer_k=5,
+    staleness_weighting="polynomial", staleness_alpha=0.5,
+    arrival_rate=2.0, arrival_jitter=0.5, straggler_tail=0.1,
+    straggler_factor=5.0)
 
 
 # --multihost lane (ROADMAP item 5): the 2-process DCN configuration the
@@ -212,6 +226,18 @@ def _make_experiment(config=None):
     return exp
 
 
+def _make_async_experiment():
+    """The --async lane's experiment: same toolchain setup as
+    _make_experiment, but warmed by the streaming driver itself (the
+    lockstep run_round warm would consume the RNG streams the first wave
+    dispatch expects)."""
+    from dba_mod_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache("/tmp/jax_cache_dba_bench")
+    from dba_mod_tpu.config import Params
+    from dba_mod_tpu.fl.experiment import Experiment
+    return Experiment(Params.from_dict(ASYNC_CONFIG), save_results=False)
+
+
 def measure_ours(exp, timed_rounds: int) -> float:
     """End-to-end seconds/round, pipelined: round N+1 dispatches before round
     N's blocking fetch, hiding the ~0.1 s tunnel round-trip."""
@@ -330,6 +356,16 @@ def timeit(fn):
     return time.perf_counter() - t0
 
 
+def host_peak_rss_bytes():
+    """Process peak resident-set high-water (bytes) — the memory ceiling
+    that matters on CPU backends, where device_peak_bytes is None. Like the
+    allocator stat it is monotone over the process lifetime: in the width
+    lane each point's value subsumes every smaller config measured before
+    it, so the last (widest) point is the series' ceiling."""
+    import resource
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+
+
 def device_peak_bytes():
     """Device-memory high-water (bytes) from the runtime's allocator stats.
     None where the backend publishes none (CPU). NOTE: peak_bytes_in_use is
@@ -394,6 +430,14 @@ def main() -> int:
                          "memory high-water per point (ROADMAP item 1's "
                          "measurement half)")
     ap.add_argument("--width-rounds", type=int, default=4)
+    ap.add_argument("--async", dest="async_lane", action="store_true",
+                    help="add the buffered-async lane: the headline "
+                         "workload through the streaming engine "
+                         "(fl/async_rounds.py) — sustained updates/sec "
+                         "and merges/sec under the arrival process, under "
+                         "'async_lane'")
+    ap.add_argument("--async-rounds", type=int, default=12,
+                    help="timed aggregation steps for the --async lane")
     ap.add_argument("--forensics-cost", action="store_true",
                     help="add the forensics-cost lane: the headline "
                          "workload with `forensics: true` and the overhead "
@@ -507,26 +551,72 @@ def main() -> int:
 
     if args.width:
         # width lane: throughput in clients*rounds/sec vs clients-per-round
-        # (C is the vmapped client axis of the fused round program)
+        # (C is the vmapped client axis of the fused round program). The
+        # C=1000 point is the ROADMAP scale target: the participant pool
+        # grows to match, fewer timed rounds amortize the heavier program,
+        # and the memory high-water ceiling across the whole sweep is
+        # reported alongside the per-point series.
         try:
             pts = []
-            for C in (10, 50, 100):
-                wexp = _make_experiment(dict(BENCH_CONFIG, no_models=C))
-                spr = measure_ours(wexp, args.width_rounds)
+            for C in (10, 50, 100, 1000):
+                wexp = _make_experiment(dict(
+                    BENCH_CONFIG, no_models=C,
+                    number_of_total_participants=max(
+                        int(BENCH_CONFIG["number_of_total_participants"]),
+                        C)))
+                spr = measure_ours(
+                    wexp, args.width_rounds if C <= 100 else
+                    max(1, args.width_rounds // 2))
                 pts.append({
                     "clients_per_round": C,
                     "rounds_per_sec": round(1.0 / spr, 4),
                     "clients_rounds_per_sec": round(C / spr, 4),
-                    "device_peak_bytes": device_peak_bytes()})
+                    "device_peak_bytes": device_peak_bytes(),
+                    "host_peak_rss_bytes": host_peak_rss_bytes()})
                 del wexp
             out["width_lane"] = {
                 "metric": "clients_rounds_per_sec_vs_width",
                 "points": pts,
-                "note": "device_peak_bytes is the allocator's process-"
-                        "lifetime high-water (monotone across points; "
-                        "null on backends without memory_stats)"}
+                "memory_ceiling_bytes": {
+                    "device": pts[-1]["device_peak_bytes"],
+                    "host_rss": pts[-1]["host_peak_rss_bytes"]},
+                "note": "device_peak_bytes/host_peak_rss_bytes are process-"
+                        "lifetime high-waters (monotone across points; "
+                        "device is null on backends without memory_stats) — "
+                        "memory_ceiling_bytes is the widest point's "
+                        "high-water, the sweep's ceiling"}
         except Exception as e:  # noqa: BLE001
             out["width_lane_error"] = str(e)
+
+    if args.async_lane:
+        # async lane: the buffered streaming engine's sustained throughput —
+        # merges/sec and client updates absorbed/sec (merges x buffer_k).
+        # Fresh experiment + driver; two untimed merges warm the wave-train
+        # + merge + eval programs before the clock starts.
+        try:
+            aexp = _make_async_experiment()
+            from dba_mod_tpu.fl.async_rounds import AsyncDriver
+            drv = AsyncDriver(aexp)
+            drv.run_steps(2)
+            t0 = time.time()
+            drv.run_steps(args.async_rounds)
+            wall = time.time() - t0
+            K = drv.K
+            out["async_lane"] = {
+                "metric": "async_buffered_updates_per_sec",
+                "merges_per_sec": round(args.async_rounds / wall, 4),
+                "updates_per_sec": round(args.async_rounds * K / wall, 4),
+                "buffer_k": K,
+                "cohort_clients": int(aexp.params["no_models"]),
+                "staleness_weighting": str(
+                    aexp.params["staleness_weighting"]),
+                "workload": "headline config through the buffered-async "
+                            "engine: 10-client cohorts, merge every 5 "
+                            "arrivals, polynomial staleness, jittered "
+                            "arrivals with a straggler tail "
+                            "(fl/async_rounds.py)"}
+        except Exception as e:  # noqa: BLE001 — lanes never break
+            out["async_lane_error"] = str(e)  # the headline number
 
     if args.forensics_cost:
         # forensics-cost lane: identical workload, forensics on. The writer

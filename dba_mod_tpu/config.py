@@ -32,6 +32,14 @@ IMAGE_TYPES = (TYPE_CIFAR, TYPE_MNIST, TYPE_TINYIMAGENET)
 AGGR_MEAN = "mean"
 AGGR_GEO_MED = "geom_median"
 AGGR_FOOLSGOLD = "foolsgold"
+# Byzantine-robust rules beyond the reference (ROADMAP item 3; no reference
+# counterpart — ops/aggregation.py documents the papers and the
+# survivor-mask contract they share with the three above).
+AGGR_KRUM = "krum"
+AGGR_TRIMMED_MEAN = "trimmed_mean"
+AGGR_MEDIAN = "median"
+AGGR_ALL = (AGGR_MEAN, AGGR_GEO_MED, AGGR_FOOLSGOLD, AGGR_KRUM,
+            AGGR_TRIMMED_MEAN, AGGR_MEDIAN)
 
 _REQUIRED_KEYS = ("type", "lr", "batch_size", "epochs", "no_models",
                   "number_of_total_participants", "eta", "aggregation_methods")
@@ -141,6 +149,44 @@ _DEFAULTS: Dict[str, Any] = {
                                    # (models/grouped.py); measured
                                    # perf-neutral vs the vmapped path —
                                    # TRAIN_FLOOR.md round-5 section
+    # --- wider defense grid (ops/aggregation.py; ROADMAP item 3) ---
+    "krum_m": 1,                   # multi-Krum selection count (1 = classic
+                                   # Krum): the m lowest-scoring clients are
+                                   # averaged into the applied update
+    "krum_byzantine_f": 0,         # assumed Byzantine count f in the Krum
+                                   # score (each client scored over its
+                                   # n-f-2 nearest peers)
+    "trimmed_mean_beta": 0.1,      # per-coordinate trim fraction: drop the
+                                   # floor(beta*n) smallest and largest
+                                   # survivor values before averaging
+    # --- asynchronous buffered federation (fl/async_rounds.py; README
+    #     "Asynchronous federation"). mode: "sync" (default) is a strict
+    #     no-op for every knob in this block — the lockstep engine does not
+    #     read them.
+    "mode": "sync",                # "async" = FedBuff-style buffered
+                                   # streaming server: clients arrive
+                                   # continuously, the server merges every
+                                   # buffer_k arrivals with
+                                   # staleness-weighted partial
+                                   # participation
+    "buffer_k": 0,                 # merge every K arrivals; 0 = no_models
+                                   # (with zero staleness weighting that
+                                   # reduces bit-exactly to the sync round)
+    "staleness_weighting": "none",  # per-update weight w(s) of merge-step
+                                   # staleness s: "none" (w=1 — the parity
+                                   # mode), "polynomial" (1/(1+s)^alpha),
+                                   # "exponential" (alpha^s)
+    "staleness_alpha": 0.5,        # the alpha of polynomial/exponential
+    "arrival_rate": 1.0,           # mean client arrivals per unit virtual
+                                   # time (exponential inter-arrival)
+    "arrival_jitter": 0.0,         # lognormal sigma multiplying each
+                                   # client's service delay (0 = none)
+    "straggler_tail": 0.0,         # P(client is a straggler this wave)
+    "straggler_factor": 10.0,      # straggler delay multiplier
+    "async_steps": 0,              # aggregation steps to run; 0 = derive
+                                   # from epochs (epochs*no_models/buffer_k
+                                   # — the same total client-update budget
+                                   # as the sync run)
     # --- fault model & robustness (fl/faults.py, README "Fault model") ---
     "fault_injection": False,      # master switch for the deterministic
                                    # fault harness (fl/faults.py); off =
@@ -257,7 +303,7 @@ class Params:
         missing = [k for k in _REQUIRED_KEYS if k not in merged]
         if missing:
             raise ValueError(f"config missing required keys: {missing}")
-        if merged["aggregation_methods"] not in (AGGR_MEAN, AGGR_GEO_MED, AGGR_FOOLSGOLD):
+        if merged["aggregation_methods"] not in AGGR_ALL:
             raise ValueError(
                 f"unknown aggregation_methods: {merged['aggregation_methods']!r}")
         if merged["type"] not in IMAGE_TYPES + (TYPE_LOAN,):
@@ -307,6 +353,52 @@ class Params:
         if not isinstance(merged["forensics"], bool):
             raise ValueError(
                 f"forensics must be true/false, got {merged['forensics']!r}")
+        if int(merged["krum_m"]) < 1:
+            raise ValueError("krum_m must be >= 1")
+        if int(merged["krum_byzantine_f"]) < 0:
+            raise ValueError("krum_byzantine_f must be >= 0")
+        beta = float(merged["trimmed_mean_beta"])
+        if not 0.0 <= beta < 0.5:
+            raise ValueError(
+                f"trimmed_mean_beta must be in [0, 0.5), got {beta}")
+        if merged["mode"] not in ("sync", "async"):
+            raise ValueError(
+                f"mode must be 'sync' or 'async', got {merged['mode']!r}")
+        if int(merged["buffer_k"]) < 0:
+            raise ValueError("buffer_k must be >= 0 (0 = no_models)")
+        if merged["staleness_weighting"] not in ("none", "polynomial",
+                                                 "exponential"):
+            raise ValueError(
+                "staleness_weighting must be 'none'/'polynomial'/"
+                f"'exponential', got {merged['staleness_weighting']!r}")
+        if float(merged["arrival_rate"]) <= 0:
+            raise ValueError("arrival_rate must be > 0")
+        if float(merged["arrival_jitter"]) < 0:
+            raise ValueError("arrival_jitter must be >= 0")
+        tail = float(merged["straggler_tail"])
+        if not 0.0 <= tail <= 1.0:
+            raise ValueError(f"straggler_tail must be in [0, 1], got {tail}")
+        if float(merged["straggler_factor"]) < 1.0:
+            raise ValueError("straggler_factor must be >= 1")
+        if int(merged["async_steps"]) < 0:
+            raise ValueError("async_steps must be >= 0")
+        if merged["mode"] == "async":
+            # the async driver's constraints, rejected at validation so a
+            # bad combo fails before data loading: FoolsGold's cross-round
+            # memory is keyed to lockstep rounds (a buffered merge has no
+            # per-round participant row to update), interval>1 segment
+            # chaining has no arrival-process analog, and sequential_debug
+            # bypasses the vmapped wave training the driver dispatches.
+            if merged["aggregation_methods"] == AGGR_FOOLSGOLD:
+                raise ValueError(
+                    "mode: async does not support foolsgold aggregation "
+                    "(cross-round memory is keyed to lockstep rounds)")
+            if int(merged["aggr_epoch_interval"]) != 1:
+                raise ValueError(
+                    "mode: async requires aggr_epoch_interval: 1")
+            if merged["sequential_debug"]:
+                raise ValueError(
+                    "mode: async is incompatible with sequential_debug")
         return cls(raw=merged)
 
     # ------------------------------------------------------------- dict access

@@ -1,0 +1,787 @@
+"""Buffered-asynchronous federation: the FedBuff-style streaming engine.
+
+The synchronous engine (fl/experiment.py) is a barrier per round: select C
+clients, train them in one vmapped program, aggregate, evaluate. The ROADMAP
+north star is a service absorbing updates as they arrive; this module is the
+buffered-asynchronous middle point of Nguyen et al., *Federated Learning
+with Buffered Asynchronous Aggregation* (AISTATS 2022): the server admits
+client updates continuously, buffers them, and merges every K arrivals with
+a staleness-weighted partial-participation rule.
+
+Shape of the simulation (single-controller, deterministic per seed):
+
+  - Client work is dispatched in *cohorts* ("waves") through the SAME
+    jitted ``engine.train_fn`` program the lockstep rounds run — one wave
+    per selection epoch, trained against the global model current at
+    dispatch. A wave's lanes then become individual *arrivals*, each with a
+    service delay drawn from the arrival process below; a new wave is
+    dispatched whenever the arrival queue drains, so stragglers from
+    earlier cohorts interleave with later cohorts and accumulate staleness.
+  - The arrival process is a pure function of ``(random_seed, wave)``:
+    Exp(1/arrival_rate) service times, optional lognormal jitter
+    (``arrival_jitter``), and a straggler tail (``straggler_tail`` fraction
+    delayed by ``straggler_factor``). Virtual time — merge ORDER is what
+    matters; no wall-clock sleeps.
+  - Every K arrivals (``buffer_k``; 0 ⇒ no_models) the buffer is merged by
+    a jitted partial-participation rule reusing the survivor-mask contract
+    of ops/aggregation.py: occupancy is a mask, the buffer is padded with
+    inert zero-delta lanes to the static K, so occupancy < K (the final
+    flush of a gracefully stopped run) compiles to the same program shape.
+  - Staleness of a buffered update = merges applied since its wave was
+    dispatched. ``staleness_weighting``: "none" (static no-op branch — the
+    weight multiply is not even traced, keeping the sync reduction
+    bit-exact), "polynomial" w(s) = (1+s)^-staleness_alpha (the FedBuff
+    paper's choice), or "exponential" w(s) = staleness_alpha^s.
+  - Faults (fl/faults.py) become arrival-process events: the same
+    deterministic per-epoch plan f(fault_seed, wave_epoch) is drawn, but a
+    *dropped* client never arrives, a *stale* client becomes a straggler
+    (its arrival is delayed by ``straggler_factor`` — the streaming
+    generalization of the lockstep lane's replay-last-round model), and
+    *corrupt*/*blowup* perturb the payload in transit; when
+    ``screen_updates`` is on, the merge screens the buffer and quarantines
+    via the mask. Host-loss lanes are a lockstep/multi-process concept and
+    are ignored here (the driver is single-controller).
+
+Sync-reduction guarantee (the keystone parity artifact,
+tests/test_async_rounds.py): with ``buffer_k == no_models`` a merge fires
+exactly when a full wave has arrived and the next wave is dispatched only
+after the merge — the cadence, RNG stream consumption, train program,
+masked-FedAvg divisor, and eval batteries all reduce to the synchronous
+round, and the recorded metrics.jsonl rows are bit-identical (modulo wall
+times and the async-only keys). This holds for ANY arrival knobs: arrival
+order within a wave cannot matter because the merge sorts its buffer by
+(wave, lane).
+
+Known deviations from the lockstep engine (documented, not silent):
+  - DP noise draws use the newest merged wave's aggregation key — merges
+    are not 1:1 with waves in general, so the sync noise stream cannot be
+    reproduced for K != C (it IS reproduced at K == C).
+  - The LOAN adaptive poison-LR probe never blocks the stream: it always
+    uses the last *finalized* backdoor accuracy (the ``stale_poison_probe``
+    behavior), one merge stale.
+  - Per-batch visualization channels (vis_train_batch_loss /
+    batch_track_distance) are not recorded in async mode.
+  - Leftover buffered updates at the end of a run are discarded (counted
+    in telemetry as ``async/unmerged_leftovers``); a graceful stop flushes
+    the partial buffer as one final padded merge instead.
+
+Checkpoint/resume: the full streaming state (version, wave counter, virtual
+clock, arrival heap, buffer, and the delta payloads of every wave still
+referenced) rides the PR-4 aux sidecar under the ``async_state`` key —
+``kill -9`` between merges resumes bit-exactly from the last committed
+merge (tests/test_async_rounds.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dba_mod_tpu import config as cfg
+from dba_mod_tpu.data import build_batch_plan
+from dba_mod_tpu.fl import faults as flt
+from dba_mod_tpu.fl.rounds import (count_bn_layers, nbt_client_deltas,
+                                   screen_client_updates)
+from dba_mod_tpu.fl.selection import select_agents
+from dba_mod_tpu.fl.state import build_client_tasks
+from dba_mod_tpu.ops import aggregation as agg
+
+logger = logging.getLogger("async_rounds")
+
+
+def staleness_weights(staleness: np.ndarray, weighting: str,
+                      alpha: float) -> np.ndarray:
+    """w(s) per buffered update, f32. "none" ⇒ ones (the caller's static
+    branch skips the multiply entirely; this exists for unit tests and the
+    recorded histogram), "polynomial" ⇒ (1+s)^-alpha (FedBuff §5),
+    "exponential" ⇒ alpha^s."""
+    s = np.asarray(staleness, np.float32)
+    if weighting == "none":
+        return np.ones_like(s)
+    if weighting == "polynomial":
+        return (1.0 + s) ** np.float32(-alpha)
+    if weighting == "exponential":
+        return np.float32(alpha) ** s
+    raise ValueError(f"unknown staleness_weighting {weighting!r}")
+
+
+class ArrivalProcess:
+    """Deterministic per-(seed, wave) service delays for a cohort's lanes.
+
+    Draws are a pure function of ``SeedSequence((seed, wave))`` — a resumed
+    run (or a re-run on another host) replays the identical arrival plan,
+    which the determinism test pins."""
+
+    def __init__(self, seed: int, rate: float, jitter: float,
+                 straggler_tail: float, straggler_factor: float):
+        if rate <= 0:
+            raise ValueError(f"arrival_rate must be > 0, got {rate}")
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.jitter = float(jitter)
+        self.straggler_tail = float(straggler_tail)
+        self.straggler_factor = float(straggler_factor)
+
+    def delays(self, wave: int, n: int) -> np.ndarray:
+        rng = np.random.default_rng(
+            np.random.SeedSequence((self.seed, int(wave))))
+        d = rng.exponential(1.0 / self.rate, size=n)
+        if self.jitter > 0:
+            d = d * rng.lognormal(0.0, self.jitter, size=n)
+        if self.straggler_tail > 0:
+            tail = rng.random(n) < self.straggler_tail
+            d = np.where(tail, d * self.straggler_factor, d)
+        return d.astype(np.float64)
+
+
+@dataclasses.dataclass
+class _Wave:
+    """One dispatched cohort: device-resident payloads + host metadata kept
+    until every lane is consumed (merged or dropped) and its per-client
+    rows are recorded."""
+    wave: int                    # 0-based cohort counter
+    epoch: int                   # wave+1 — selection/poison-schedule epoch
+    base_version: int            # merge count at dispatch (staleness base)
+    names: List[Any]
+    adv_names: List[Any]
+    tasks: Any                   # host-side ClientTask (np leaves)
+    deltas: Any                  # [C] stacked ModelVars tree (post-fault)
+    nbt: jax.Array               # [C] num_batches_tracked deltas
+    num_samples: np.ndarray      # [C] f32
+    pids: np.ndarray             # [C] i32
+    rng_agg: jax.Array           # this wave's aggregation key
+    metrics_dev: Any             # TrainResult.metrics handles (or np, post-resume)
+    locals_dev: Any              # LocalEvals handles or None
+    delta_norms: Any             # [C] device/np
+    outstanding: int             # lanes not yet consumed
+    recorded: bool = False
+
+
+class AsyncDriver:
+    """The persistent buffered-async server loop over one Experiment."""
+
+    def __init__(self, exp):
+        p = exp.params
+        if jax.process_count() > 1:
+            raise ValueError("mode: async is single-controller only")
+        if exp.mesh is not None:
+            raise ValueError(
+                "mode: async does not support a sharded clients mesh yet "
+                "(set num_devices: 0); the wave train program is "
+                "single-device in this version")
+        if exp.sequential_debug:
+            raise ValueError("mode: async is incompatible with "
+                             "sequential_debug")
+        self.exp = exp
+        self.C = int(p["no_models"])
+        self.K = int(p.get("buffer_k", 0) or 0) or self.C
+        self.weighting = str(p.get("staleness_weighting", "none"))
+        self.alpha = float(p.get("staleness_alpha", 0.5))
+        self.arrivals = ArrivalProcess(
+            seed=int(p.get("random_seed") or 0),
+            rate=float(p.get("arrival_rate", 1.0)),
+            jitter=float(p.get("arrival_jitter", 0.0)),
+            straggler_tail=float(p.get("straggler_tail", 0.0)),
+            straggler_factor=float(p.get("straggler_factor", 10.0)))
+        if bool(p.get("vis_train_batch_loss")) or bool(
+                p.get("batch_track_distance")):
+            logger.warning("async mode does not record per-batch channels; "
+                           "vis_train_batch_loss/batch_track_distance rows "
+                           "will be absent")
+        # streaming state
+        self.version = 0          # merges applied
+        self.wave = 0             # cohorts dispatched
+        self.clock = 0.0          # virtual time of the last consumed arrival
+        self._seq = 0             # heap tie-break
+        self._heap: List[Tuple[float, int, int, int]] = []  # (t, seq, wid, lane)
+        self._buffer: List[Tuple[int, int]] = []            # (wid, lane)
+        self._waves: Dict[int, _Wave] = {}
+        self._pending_dropped = 0
+        self._dispatch_wall = 0.0
+        self._total_arrivals = 0
+        self._merge_fn = self._build_merge_fn()
+        fcfg = exp.engine.fault_cfg
+        self._perturb_fn = (jax.jit(
+            lambda tree, plan: flt.perturb_tree(tree, plan, fcfg))
+            if fcfg.enabled else None)
+        self._restore(exp._resume_aux)
+
+    # ------------------------------------------------------------ merge rule
+    def _build_merge_fn(self):
+        """The jitted staleness-weighted partial-participation merge over
+        the padded [K] buffer. Mirrors engine.aggregate_fn's rule dispatch
+        but with the BUFFER as the participation unit: the masked-FedAvg
+        divisor counts occupied surviving lanes out of K (so a full,
+        unscreened buffer at K == no_models is bitwise the dense sync
+        FedAvg — ops/aggregation.py's scale-rewrite), and every rule gets
+        the occupancy/survivor mask. The staleness multiply is a STATIC
+        branch: "none" traces no weighting ops at all."""
+        exp = self.exp
+        hyper = exp.engine.hyper
+        screening = exp.engine.screening
+        norm_mult = float(exp.engine.base_norm_mult)
+        weighting = self.weighting
+        K = self.K
+        if hyper.aggregation == cfg.AGGR_FOOLSGOLD:  # config.py rejects too
+            raise ValueError("foolsgold is stateful per-round and has no "
+                             "buffered-async form; pick another rule")
+
+        def merge(global_vars, deltas, nbt, ns, occ, w, rng):
+            # deltas: [K] stacked tree; occ [K] bool occupancy; w [K] f32
+            if weighting != "none":
+                deltas = jax.tree_util.tree_map(
+                    lambda l: (l * agg._bc_mask(w, l)
+                               if jnp.issubdtype(l.dtype, jnp.floating)
+                               else l), deltas)
+            mask = occ
+            n_quar = jnp.int32(0)
+            if screening:
+                surv, _ = screen_client_updates(
+                    deltas, occ, occ, jnp.float32(norm_mult))
+                mask = occ & surv
+                n_quar = jnp.sum((occ & ~surv).astype(jnp.int32))
+            sigma = hyper.sigma if hyper.diff_privacy else 0.0
+            wv = jnp.zeros((K,), jnp.float32)
+            alpha = jnp.zeros((K,), jnp.float32)
+            calls = jnp.int32(1)
+            is_updated = jnp.asarray(True)
+            if hyper.aggregation == cfg.AGGR_MEAN:
+                # counted=ones ⇒ divisor = #surviving occupied lanes: the
+                # partial flush is a true mean over present updates, and a
+                # full unscreened buffer keeps the dense eta/K scale bitwise
+                new_vars = agg.fedavg_update_masked(
+                    global_vars, deltas, hyper.eta, K, mask,
+                    jnp.ones((K,), bool), sigma, rng)
+            elif hyper.aggregation == cfg.AGGR_GEO_MED:
+                r = agg.geometric_median_update(
+                    global_vars, deltas, ns, hyper.eta,
+                    maxiter=hyper.geom_median_maxiter,
+                    max_update_norm=hyper.max_update_norm,
+                    dp_sigma=sigma, rng=rng, nbt_deltas=nbt,
+                    n_bn=count_bn_layers(global_vars.batch_stats),
+                    mask=mask)
+                new_vars, calls, wv, alpha = (r.new_state,
+                                              r.num_oracle_calls, r.wv,
+                                              r.distances)
+                is_updated = r.is_updated
+            elif hyper.aggregation == cfg.AGGR_KRUM:
+                r = agg.krum_update(global_vars, deltas, hyper.eta,
+                                    hyper.krum_m, hyper.krum_f, mask=mask,
+                                    dp_sigma=sigma, rng=rng)
+                new_vars, wv = r.new_state, r.wv
+                alpha = jnp.minimum(r.scores, jnp.float32(1e30))
+            elif hyper.aggregation == cfg.AGGR_TRIMMED_MEAN:
+                r = agg.trimmed_mean_update(global_vars, deltas, hyper.eta,
+                                            hyper.trim_beta, mask=mask,
+                                            dp_sigma=sigma, rng=rng)
+                new_vars, wv = r.new_state, r.wv
+            else:  # cfg.AGGR_MEDIAN
+                r = agg.coordinate_median_update(global_vars, deltas,
+                                                 hyper.eta, mask=mask,
+                                                 dp_sigma=sigma, rng=rng)
+                new_vars, wv = r.new_state, r.wv
+            return new_vars, wv, alpha, calls, is_updated, n_quar
+
+        return jax.jit(merge)
+
+    # --------------------------------------------------------------- running
+    def run(self, epochs: Optional[int] = None) -> Dict[str, Any]:
+        """The persistent server loop: fill the buffer from the arrival
+        queue (dispatching cohorts on demand), merge, record, checkpoint —
+        until the merge budget is spent or a graceful stop lands."""
+        exp = self.exp
+        p = exp.params
+        eps = int(epochs if epochs is not None else p["epochs"])
+        total = int(p.get("async_steps", 0) or 0)
+        if total <= 0:
+            # same client-update budget as `epochs` sync rounds — at
+            # K == C this is exactly `epochs` merges
+            total = max(1, eps * self.C // self.K)
+        last: Dict[str, Any] = {}
+        while self.version < total:
+            if exp.guard.stop_requested:
+                if self._buffer:
+                    # graceful stop: flush the partial buffer as one final
+                    # padded merge (occupancy < K — same compiled shape)
+                    last = self._merge_and_record()
+                    self._save()
+                exp.interrupted = True
+                logger.warning(
+                    "graceful stop honored at the merge boundary after "
+                    "step %d (resume with --resume auto)", self.version)
+                break
+            self._fill_buffer()
+            last = self._merge_and_record()
+            self._save()
+            exp.telemetry.mark_warm()
+            logger.info(
+                "merge %d/%d done acc=%.2f staleness_mean=%.2f "
+                "occupancy=%d/%d", self.version, total, last["global_acc"],
+                last["staleness_mean"], last["buffer_occupancy"], self.K)
+        leftovers = len(self._buffer) + len(self._heap)
+        if leftovers and not exp.interrupted:
+            exp.telemetry.counter("async/unmerged_leftovers").inc(leftovers)
+            logger.info("run end: %d buffered/in-flight updates discarded "
+                        "(budget of %d merges spent)", leftovers, total)
+        return last
+
+    def run_steps(self, n: int) -> Dict[str, Any]:
+        """Run exactly n merges (bench.py's --async lane), no checkpoints."""
+        last: Dict[str, Any] = {}
+        for _ in range(n):
+            self._fill_buffer()
+            last = self._merge_and_record()
+        return last
+
+    def _save(self):
+        self.exp.save_model(self.version,
+                            extra_aux={"async_state": self._snapshot()})
+
+    # ------------------------------------------------------ arrivals / waves
+    def _fill_buffer(self):
+        """Pop arrivals into the buffer until it holds K; dispatch a new
+        cohort whenever the queue drains. Virtual time advances to each
+        consumed arrival."""
+        exp = self.exp
+        empty_waves = 0
+        while len(self._buffer) < self.K:
+            while not self._heap:
+                before = len(self._heap)
+                self._dispatch_wave()
+                if len(self._heap) == before:
+                    empty_waves += 1
+                    if empty_waves > 200:
+                        raise RuntimeError(
+                            "async arrival queue starved: 200 consecutive "
+                            "cohorts produced no arrivals (fault dropout "
+                            "too aggressive?)")
+                else:
+                    empty_waves = 0
+            t, _seq, wid, lane = heapq.heappop(self._heap)
+            self.clock = max(self.clock, t)
+            self._buffer.append((wid, lane))
+            self._total_arrivals += 1
+            exp.telemetry.counter("async/arrivals").inc()
+            exp.telemetry.gauge("async/buffer_occupancy").set(
+                len(self._buffer))
+
+    def _dispatch_wave(self):
+        """Select + train one cohort through the lockstep train program and
+        enqueue its lanes as future arrivals. Consumes the selection/plan/
+        train RNG streams exactly like a sync round dispatch — the parity
+        anchor."""
+        exp = self.exp
+        p = exp.params
+        wid = self.wave
+        self.wave += 1
+        epoch = wid + 1
+        t0 = time.perf_counter()
+        with exp.telemetry.span("async/dispatch_wave"):
+            agent_names, adv_names = select_agents(
+                p, epoch, exp.participants, exp.benign_names, exp.select_rng)
+            backdoor_acc = None
+            if (p.type == cfg.TYPE_LOAN and exp.is_poison_run
+                    and any(p.adversary_slot_of(n) >= 0 and
+                            epoch in p.poison_epochs_for(
+                                p.adversary_slot_of(n))
+                            for n in agent_names)):
+                # never block the stream on a probe: one merge stale
+                backdoor_acc = exp.last_backdoor_acc
+            slots = np.array([exp.client_slots[n] for n in agent_names],
+                             np.int64)
+            tasks = build_client_tasks(p, agent_names, epoch, slots,
+                                       exp.epochs_max, backdoor_acc)
+            if exp.dynamic_steps:
+                b = int(p["batch_size"])
+                round_max = max((len(exp.client_indices[n])
+                                 for n in agent_names), default=1)
+                min_steps = exp._bucket_steps(
+                    max(1, int(np.ceil(round_max / b))))
+            else:
+                min_steps = exp.steps_per_epoch
+            plan = build_batch_plan(
+                [exp.client_indices[n] for n in agent_names],
+                [int(e) for e in tasks.num_epochs], int(p["batch_size"]),
+                exp.plan_rng, min_steps=min_steps,
+                min_epochs=exp.epochs_max)
+            tasks_seq = jax.tree_util.tree_map(
+                lambda l: jnp.asarray(l[None]), tasks)
+            idx_seq = jnp.asarray(plan.idx[None])
+            mask_seq = jnp.asarray(plan.mask[None])
+            exp.rng_key, round_key = jax.random.split(exp.rng_key)
+            rng_train, rng_agg = jax.random.split(round_key)
+            lane = jnp.arange(len(agent_names), dtype=jnp.int32)
+            train = exp.engine.train_fn(exp.global_vars, tasks_seq, idx_seq,
+                                        mask_seq, lane, rng_train)
+            nbt = nbt_client_deltas(mask_seq, tasks_seq.scale)
+            locals_dev = None
+            if exp.local_eval:
+                tasks_last = jax.tree_util.tree_map(lambda l: l[0],
+                                                    tasks_seq)
+                prev = jax.tree_util.tree_map(jnp.zeros_like, train.deltas)
+                locals_dev = exp.engine.local_evals_fn(
+                    exp.global_vars, train.deltas, tasks_last, prev)
+            deltas = train.deltas
+            dropped = np.zeros(len(agent_names), bool)
+            delay_mult = np.ones(len(agent_names))
+            fcfg = exp.engine.fault_cfg
+            if fcfg.enabled:
+                # faults as arrival events: same deterministic per-epoch
+                # plan as the lockstep lanes — dropped never arrives, stale
+                # straggles, corrupt/blowup perturb the payload in transit
+                rng_f = jax.random.fold_in(exp._fault_key, epoch)
+                fplan = flt.make_fault_plan(
+                    fcfg, rng_f, jnp.ones((len(agent_names),), bool))
+                fhost = jax.device_get(fplan)
+                dropped = np.asarray(fhost.dropped)
+                delay_mult = np.where(np.asarray(fhost.stale),
+                                      self.arrivals.straggler_factor, 1.0)
+                deltas = self._perturb_fn(deltas, fplan)
+            self._pending_dropped += int(dropped.sum())
+            delays = self.arrivals.delays(wid, len(agent_names)) * delay_mult
+            for c in range(len(agent_names)):
+                if dropped[c]:
+                    continue
+                heapq.heappush(self._heap,
+                               (self.clock + float(delays[c]), self._seq,
+                                wid, c))
+                self._seq += 1
+            self._waves[wid] = _Wave(
+                wave=wid, epoch=epoch, base_version=self.version,
+                names=list(agent_names), adv_names=list(adv_names),
+                tasks=tasks, deltas=deltas, nbt=nbt,
+                num_samples=plan.num_samples.astype(np.float32),
+                pids=np.asarray(tasks.participant_id),
+                rng_agg=rng_agg, metrics_dev=train.metrics,
+                locals_dev=locals_dev, delta_norms=train.delta_norms,
+                outstanding=int(len(agent_names) - dropped.sum()))
+            if self._waves[wid].outstanding == 0:
+                # fully dropped cohort: record its train rows now and free it
+                self._record_wave_rows(self._waves[wid])
+                del self._waves[wid]
+        exp.telemetry.counter("async/waves").inc()
+        self._dispatch_wall += time.perf_counter() - t0
+
+    # ----------------------------------------------------------------- merge
+    def _merge_and_record(self) -> Dict[str, Any]:
+        """Merge the buffer (padded to K), advance the version, run the
+        global battery, and record one metrics.jsonl row keyed by the
+        aggregation step."""
+        exp = self.exp
+        t0 = time.perf_counter()
+        step = self.version + 1
+        exp.telemetry.set_epoch(step)
+        entries = sorted(self._buffer)     # (wave, lane) — deterministic
+        self._buffer = []
+        B = len(entries)
+        # per-client rows for cohorts that fully resolved with this batch
+        for wid, _lane in entries:
+            self._waves[wid].outstanding -= 1
+        for wid in sorted({w for w, _ in entries}):
+            w = self._waves[wid]
+            if w.outstanding == 0 and not w.recorded:
+                self._record_wave_rows(w)
+        names = [self._waves[w].names[lane] for w, lane in entries]
+        merged_by_wave: Dict[int, set] = {}
+        for (wid, lane) in entries:
+            merged_by_wave.setdefault(wid, set()).add(lane)
+        adversaries: List[Any] = []
+        for wid in sorted(merged_by_wave):
+            w = self._waves[wid]
+            present = {w.names[ln] for ln in merged_by_wave[wid]}
+            adversaries.extend(n for n in w.adv_names if n in present)
+        with exp.telemetry.span("async/merge"):
+            deltas, nbt, ns, pids = self._gather(entries)
+            staleness = np.array(
+                [self.version - self._waves[w].base_version
+                 for w, _ in entries], np.float32)
+            for s in staleness:
+                exp.telemetry.histogram("staleness").observe(float(s))
+            w_full = np.zeros((self.K,), np.float32)
+            w_full[:B] = staleness_weights(staleness, self.weighting,
+                                           self.alpha)
+            occ = np.zeros((self.K,), bool)
+            occ[:B] = True
+            rng = self._waves[max(w for w, _ in entries)].rng_agg
+            new_vars, wv, alpha, calls, is_updated, n_quar = self._merge_fn(
+                exp.global_vars, deltas, nbt, jnp.asarray(ns),
+                jnp.asarray(occ), jnp.asarray(w_full), rng)
+            globals_dev = exp.engine.global_evals_fn(new_vars)
+        exp.global_vars = new_vars
+        self.version = step
+        # free fully-consumed cohorts (their payloads are merged + recorded)
+        for wid in [w for w, v in self._waves.items()
+                    if v.outstanding == 0 and v.recorded]:
+            del self._waves[wid]
+        with exp.telemetry.span("async/finalize"):
+            t_fin = time.perf_counter()
+            globals_, wv_h, alpha_h, is_upd_h, n_quar_h = jax.device_get(
+                (globals_dev, wv, alpha, is_updated, n_quar))
+        finalize_time = time.perf_counter() - t_fin
+        exp.last_is_updated = bool(is_upd_h)
+        exp.last_global_loss = float(globals_.clean.loss)
+        if exp.is_poison_run:
+            exp.last_backdoor_acc = float(globals_.poison.acc)
+        times = {"round_time": time.perf_counter() - t0,
+                 "dispatch_time": self._dispatch_wall,
+                 "finalize_time": finalize_time}
+        self._dispatch_wall = 0.0
+        robust = {"n_quarantined": int(n_quar_h),
+                  "n_dropped": self._pending_dropped,
+                  "n_retries": 0, "degraded": False}
+        self._pending_dropped = 0
+        extras = {"mode": "async", "buffer_occupancy": B,
+                  "staleness_mean": float(staleness.mean()) if B else 0.0,
+                  "staleness_max": float(staleness.max()) if B else 0.0,
+                  "waves_dispatched": self.wave,
+                  "arrivals_total": self._total_arrivals,
+                  "virtual_time": self.clock}
+        self._record_merge(step, entries, names, adversaries, globals_,
+                           wv_h, alpha_h, times, robust, extras)
+        exp.telemetry.counter("async/merges").inc()
+        exp.telemetry.counter("async/updates_merged").inc(B)
+        self._flush_merge_telemetry(step, robust, times)
+        return {"epoch": step, "agents": names,
+                "global_acc": float(globals_.clean.acc),
+                "backdoor_acc": (float(globals_.poison.acc)
+                                 if exp.is_poison_run else None),
+                **times, **robust, **extras}
+
+    def _gather(self, entries):
+        """Assemble the padded [K] merge batch from the per-wave stacked
+        payloads, grouped per wave (one gather per cohort, not per lane).
+        Inert padding lanes are zero-delta and masked out by occupancy —
+        the same contract as the lockstep mesh padding."""
+        groups: List[Tuple[_Wave, List[int]]] = []
+        for wid, lane in entries:  # entries sorted ⇒ groups contiguous
+            w = self._waves[wid]
+            if groups and groups[-1][0] is w:
+                groups[-1][1].append(lane)
+            else:
+                groups.append((w, [lane]))
+        d_parts, n_parts, ns_parts, pid_parts = [], [], [], []
+        for w, lanes in groups:
+            if lanes == list(range(len(w.names))):
+                d_parts.append(w.deltas)   # whole-cohort fast path — and
+                n_parts.append(w.nbt)      # the K == C parity path: the
+                # buffer IS the wave, untouched by any gather op
+            else:
+                idx = jnp.asarray(lanes, jnp.int32)
+                d_parts.append(jax.tree_util.tree_map(
+                    lambda l: jnp.take(l, idx, axis=0), w.deltas))
+                n_parts.append(jnp.take(w.nbt, idx, axis=0))
+            ns_parts.append(w.num_samples[lanes])
+            pid_parts.append(w.pids[lanes])
+        pad = self.K - len(entries)
+        if pad:
+            zero = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((pad,) + l.shape[1:], l.dtype),
+                d_parts[0])
+            d_parts.append(zero)
+            n_parts.append(jnp.zeros((pad,), jnp.float32))
+            ns_parts.append(np.zeros((pad,), np.float32))
+            pid_parts.append(np.zeros((pad,), np.int32))
+        if len(d_parts) == 1:
+            deltas, nbt = d_parts[0], n_parts[0]
+        else:
+            deltas = jax.tree_util.tree_map(
+                lambda *ls: jnp.concatenate(ls, axis=0), *d_parts)
+            nbt = jnp.concatenate(n_parts, axis=0)
+        return (deltas, nbt, np.concatenate(ns_parts).astype(np.float32),
+                np.concatenate(pid_parts).astype(np.int32))
+
+    # ------------------------------------------------------------- recording
+    def _record_wave_rows(self, w: _Wave):
+        """Per-client rows for one fully-resolved cohort: train metrics and
+        (when local_eval) the local battery — the same row semantics as the
+        lockstep recorder block for an interval-1 round, keyed by the
+        cohort's selection epoch."""
+        exp = self.exp
+        rec = exp.recorder
+        params = exp.params
+        w.recorded = True
+        metrics, locals_, delta_norms = jax.device_get(
+            (w.metrics_dev, w.locals_dev, w.delta_norms))
+        w.metrics_dev, w.locals_dev = None, None
+        baseline = bool(params["baseline"])
+        ppb = np.asarray(w.tasks.poisoning_per_batch)
+        adv_slot = np.asarray(w.tasks.adv_slot)
+        for c, name in enumerate(w.names):
+            n_e = int(w.tasks.num_epochs[c])
+            for e in range(n_e):
+                count = max(float(metrics.count[0, c, e]), 1.0)
+                rec.add_train(name, (w.epoch - 1) * n_e + e + 1, w.epoch,
+                              e + 1,
+                              float(metrics.loss_sum[0, c, e]) / count,
+                              100.0 * float(metrics.correct[0, c, e])
+                              / count,
+                              int(metrics.correct[0, c, e]), int(count))
+            poisoning = bool(ppb[c] > 0)
+            if locals_ is not None:
+                lr = locals_
+                if not (poisoning and baseline):
+                    rec.add_test(name, w.epoch, float(lr.clean.loss[c]),
+                                 float(lr.clean.acc[c]),
+                                 int(lr.clean.correct[c]),
+                                 int(lr.clean.count[c]))
+                if poisoning and exp.is_poison_run:
+                    if not baseline:
+                        rec.add_poisontest(name, w.epoch,
+                                           float(lr.poison_pre.loss[c]),
+                                           float(lr.poison_pre.acc[c]),
+                                           int(lr.poison_pre.correct[c]),
+                                           int(lr.poison_pre.count[c]))
+                    rec.add_poisontest(name, w.epoch,
+                                       float(lr.poison_post.loss[c]),
+                                       float(lr.poison_post.acc[c]),
+                                       int(lr.poison_post.correct[c]),
+                                       int(lr.poison_post.count[c]))
+                if exp.is_poison_run and int(adv_slot[c]) >= 0:
+                    rec.add_triggertest(
+                        name, f"{name}_trigger", "", w.epoch,
+                        float(lr.agent_trigger.loss[c]),
+                        float(lr.agent_trigger.acc[c]),
+                        int(lr.agent_trigger.correct[c]),
+                        int(lr.agent_trigger.count[c]))
+            if poisoning and not baseline:
+                rec.scale_temp_one_row.extend(
+                    [w.epoch, round(float(delta_norms[c]), 4)])
+
+    def _record_merge(self, step, entries, names, adversaries, globals_,
+                      wv, alpha, times, robust, extras):
+        """Global battery rows + the metrics.jsonl row for one merge —
+        keyed by the aggregation step, same semantic keys as a sync round
+        plus the async extras."""
+        exp = self.exp
+        rec = exp.recorder
+        params = exp.params
+        rec.add_test("global", step, float(globals_.clean.loss),
+                     float(globals_.clean.acc), int(globals_.clean.correct),
+                     int(globals_.clean.count))
+        if exp.is_poison_run:
+            g = globals_
+            rec.add_poisontest("global", step, float(g.poison.loss),
+                               float(g.poison.acc), int(g.poison.correct),
+                               int(g.poison.count))
+            rec.add_triggertest("global", "combine", "", step,
+                                float(g.poison.loss), float(g.poison.acc),
+                                int(g.poison.correct), int(g.poison.count))
+            if params.is_centralized_attack:
+                tnames = [f"global_in_index_{j}_trigger"
+                          for j in range(exp.engine.num_global_triggers)]
+            else:
+                tnames = [f"global_in_{a}_trigger"
+                          for a in params.adversary_list]
+            for j, tname in enumerate(tnames):
+                rec.add_triggertest(
+                    "global", tname, "", step,
+                    float(g.per_trigger.loss[j]),
+                    float(g.per_trigger.acc[j]),
+                    int(g.per_trigger.correct[j]),
+                    int(g.per_trigger.count[j]))
+        if rec.scale_temp_one_row:
+            rec.scale_temp_one_row.append(
+                round(float(globals_.clean.acc), 4))
+        if params.aggregation != cfg.AGGR_MEAN:
+            rec.add_weight_result([str(n) for n in names],
+                                  np.asarray(wv)[:len(names)].tolist(),
+                                  np.asarray(alpha)[:len(names)].tolist(),
+                                  epoch=step)
+        rec.add_round_json(
+            epoch=step, agents=[str(n) for n in names],
+            adversaries=[str(a) for a in adversaries],
+            is_updated=exp.last_is_updated,
+            global_acc=float(globals_.clean.acc),
+            global_loss=float(globals_.clean.loss),
+            backdoor_acc=(float(globals_.poison.acc)
+                          if exp.is_poison_run else None),
+            **times, **robust, **extras)
+        rec.save(exp.is_poison_run)
+
+    def _flush_merge_telemetry(self, step, robust, times):
+        t = self.exp.telemetry
+        if not t.enabled:
+            return
+        t.counter("rounds").inc()
+        if robust.get("n_quarantined"):
+            t.counter("clients_quarantined").inc(robust["n_quarantined"])
+        if robust.get("n_dropped"):
+            t.counter("clients_dropped").inc(robust["n_dropped"])
+        t.histogram("round_seconds").observe(times["round_time"])
+        t.flush_round(step)
+
+    # ------------------------------------------------------ checkpoint state
+    def _snapshot(self) -> Dict[str, Any]:
+        """Host-picklable streaming state for the aux sidecar: everything
+        needed to resume the arrival queue and buffer bit-exactly. Wave
+        payloads are np trees; device handles for unrecorded rows are
+        fetched here (they must survive the process dying)."""
+        waves = {}
+        live = ({e[2] for e in self._heap} | {w for w, _ in self._buffer})
+        for wid in live:
+            w = self._waves[wid]
+            metrics, locals_, norms = jax.device_get(
+                (w.metrics_dev, w.locals_dev, w.delta_norms))
+            waves[wid] = {
+                "wave": w.wave, "epoch": w.epoch,
+                "base_version": w.base_version, "names": w.names,
+                "adv_names": w.adv_names,
+                "tasks": jax.tree_util.tree_map(np.asarray, w.tasks),
+                "deltas": jax.tree_util.tree_map(np.asarray, w.deltas),
+                "nbt": np.asarray(w.nbt),
+                "num_samples": w.num_samples, "pids": w.pids,
+                "rng_agg": np.asarray(jax.random.key_data(w.rng_agg)),
+                "metrics": metrics, "locals": locals_,
+                "delta_norms": np.asarray(norms),
+                "outstanding": w.outstanding, "recorded": w.recorded}
+        return {"version": self.version, "wave": self.wave,
+                "clock": self.clock, "seq": self._seq,
+                "heap": list(self._heap), "buffer": list(self._buffer),
+                "pending_dropped": self._pending_dropped,
+                "total_arrivals": self._total_arrivals, "waves": waves}
+
+    def _restore(self, aux: Optional[Dict[str, Any]]):
+        st = (aux or {}).get("async_state")
+        if st is None:
+            if self.exp.start_epoch > 1:
+                # model-only resume (no/discarded sidecar): restart the
+                # stream at the committed version with an empty buffer —
+                # the arrival queue is rebuilt from fresh cohorts
+                self.version = self.exp.start_epoch - 1
+                self.wave = self.version * self.K // max(self.C, 1)
+                logger.warning(
+                    "async resume without a streaming sidecar: restarting "
+                    "the arrival queue at merge %d (buffer state lost)",
+                    self.version)
+            return
+        self.version = int(st["version"])
+        self.wave = int(st["wave"])
+        self.clock = float(st["clock"])
+        self._seq = int(st["seq"])
+        self._heap = [tuple(e) for e in st["heap"]]
+        heapq.heapify(self._heap)
+        self._buffer = [tuple(e) for e in st["buffer"]]
+        self._pending_dropped = int(st["pending_dropped"])
+        self._total_arrivals = int(st["total_arrivals"])
+        for wid, d in st["waves"].items():
+            self._waves[int(wid)] = _Wave(
+                wave=int(d["wave"]), epoch=int(d["epoch"]),
+                base_version=int(d["base_version"]), names=d["names"],
+                adv_names=d["adv_names"], tasks=d["tasks"],
+                deltas=jax.tree_util.tree_map(jnp.asarray, d["deltas"]),
+                nbt=jnp.asarray(d["nbt"]),
+                num_samples=d["num_samples"], pids=d["pids"],
+                rng_agg=jax.random.wrap_key_data(jnp.asarray(d["rng_agg"])),
+                metrics_dev=d["metrics"], locals_dev=d["locals"],
+                delta_norms=d["delta_norms"],
+                outstanding=int(d["outstanding"]),
+                recorded=bool(d["recorded"]))
+        logger.info("async resume: merge %d, %d cohorts live, %d buffered, "
+                    "%d in flight", self.version, len(self._waves),
+                    len(self._buffer), len(self._heap))

@@ -1287,7 +1287,8 @@ class Experiment:
         return self._ckpt_mgr
 
     def save_model(self, epoch: int, fl: Optional[RoundInFlight] = None,
-                   async_save: bool = False):
+                   async_save: bool = False,
+                   extra_aux: Optional[Dict[str, Any]] = None):
         """Checkpoint the round's post-aggregation state. With `fl`, saves
         the state captured at that round's dispatch (required under
         pipelining — the live attributes already belong to the next round);
@@ -1295,7 +1296,10 @@ class Experiment:
         overlaps the next round's compute (run() waits before returning).
         Every committed snapshot gets an integrity manifest (immediately
         for sync saves; once the commit provably landed for async ones),
-        then retention GC runs (checkpoint.py::CheckpointManager)."""
+        then retention GC runs (checkpoint.py::CheckpointManager).
+        `extra_aux` merges additional keys into the full-state sidecar —
+        the buffered-async driver rides its streaming state (arrival heap,
+        buffer, live cohorts) here under ``async_state``."""
         params = self.params
         if not params["save_model"] or self.folder is None:
             return
@@ -1337,6 +1341,8 @@ class Experiment:
                        "best_loss": float(self.best_loss),
                        "last_backdoor_acc": self.last_backdoor_acc,
                        **rng}
+                if extra_aux:
+                    aux.update(extra_aux)
                 if self.engine.fault_cfg.stale_enabled:
                     # the stale lane's replay source: what the server
                     # received THIS round (deltas_after under pipelining —
@@ -1431,6 +1437,13 @@ class Experiment:
         print(t.summary_table())
 
     def _run_rounds(self, epochs: Optional[int] = None) -> Dict[str, Any]:
+        if str(self.params.get("mode", "sync")) == "async":
+            # the buffered-async engine owns the whole loop: cohort
+            # dispatch, arrival simulation, K-arrival merges, recording,
+            # and checkpointing (fl/async_rounds.py). run()'s guard /
+            # wait_for_async_saves / telemetry teardown still wrap it.
+            from dba_mod_tpu.fl.async_rounds import AsyncDriver
+            return AsyncDriver(self).run(epochs)
         last: Dict[str, Any] = {}
         end = epochs if epochs is not None else int(self.params["epochs"])
         profile_dir = str(self.params.get("profile_dir", "") or "")

@@ -387,6 +387,34 @@ class RoundEngine:
                 new_vars, calls, wv, alpha = (r.new_state, r.num_oracle_calls,
                                               r.wv, r.distances)
                 is_updated = r.is_updated
+            elif hyper.aggregation == cfg.AGGR_KRUM:
+                r = agg.krum_update(
+                    global_vars, deltas, hyper.eta, hyper.krum_m,
+                    hyper.krum_f, mask=mask,
+                    dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
+                    rng=rng)
+                # wv = applied selection weights; alpha records the Krum
+                # scores (clipped into a plottable range — excluded
+                # sentinels are ~1e35)
+                new_vars = r.new_state
+                wv = r.wv
+                alpha = jnp.minimum(r.scores, jnp.float32(1e30))
+            elif hyper.aggregation in (cfg.AGGR_TRIMMED_MEAN,
+                                       cfg.AGGR_MEDIAN):
+                if hyper.aggregation == cfg.AGGR_TRIMMED_MEAN:
+                    r = agg.trimmed_mean_update(
+                        global_vars, deltas, hyper.eta, hyper.trim_beta,
+                        mask=mask,
+                        dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
+                        rng=rng)
+                else:
+                    r = agg.coordinate_median_update(
+                        global_vars, deltas, hyper.eta, mask=mask,
+                        dp_sigma=hyper.sigma if hyper.diff_privacy else 0.0,
+                        rng=rng)
+                new_vars = r.new_state
+                wv = r.wv  # uniform survivor weights (coordinate-wise
+                # rules have no per-client scalar weight; alpha stays 0)
             else:  # foolsgold
                 r = agg.foolsgold_update(
                     global_vars.params, fg_grads, fg_feature,

@@ -58,6 +58,9 @@ class RoundHyper:
     alpha_loss: float = 1.0    # static: 1.0 ⇒ the blended-loss distance
                                # term is identically zero and its (fwd+bwd)
                                # compute is skipped at trace time
+    krum_m: int = 1            # multi-Krum selection count (krum only)
+    krum_f: int = 0            # assumed Byzantine count in the Krum score
+    trim_beta: float = 0.1     # trimmed-mean per-coordinate trim fraction
 
     @classmethod
     def from_params(cls, p: cfg.Params) -> "RoundHyper":
@@ -75,7 +78,10 @@ class RoundHyper:
                    max_update_norm=(None if mun is None else float(mun)),
                    track_batches=bool(p.get("vis_train_batch_loss")
                                       or p.get("batch_track_distance")),
-                   alpha_loss=float(p["alpha_loss"]))
+                   alpha_loss=float(p["alpha_loss"]),
+                   krum_m=int(p.get("krum_m", 1)),
+                   krum_f=int(p.get("krum_byzantine_f", 0)),
+                   trim_beta=float(p.get("trimmed_mean_beta", 0.1)))
 
 
 def build_client_tasks(params: cfg.Params, agent_names: list, epoch: int,

@@ -27,6 +27,25 @@ where-zeroed FIRST (`survivor_sanitize`) so NaN/Inf quarantined payloads
 cannot leak through `0 * NaN = NaN` arithmetic. With an all-ones mask every
 masked rule reduces exactly (bitwise for FedAvg, to f32 identity for the
 rest) to the dense rule — tests/test_faults.py pins this.
+
+Beyond the reference's three rules, the wider defense grid (ROADMAP item 3)
+adds three classical Byzantine-robust rules under the SAME survivor-mask
+contract, so they compose with the quarantine screen and with the async
+buffered merge (fl/async_rounds.py) unchanged:
+
+- Krum / multi-Krum (`krum_update`, Blanchard et al., NeurIPS 2017): score
+  each client by the sum of squared distances to its n−f−2 nearest peers,
+  apply η · mean of the m lowest-scoring updates (m=1 is classic Krum).
+- Coordinate-wise trimmed mean (`trimmed_mean_update`, Yin et al., ICML
+  2018): per coordinate, drop the ⌊β·n⌋ smallest and largest survivor
+  values and average the rest, apply with η.
+- Coordinate-wise median (`coordinate_median_update`, Yin et al., ICML
+  2018): per-coordinate survivor median, applied with η.
+
+These three have no reference counterpart (no parity constraint); the
+masked form IS the rule — mask=None runs the identical program with an
+all-ones mask, so dense-reduction equivalence is structural and the tests
+pin it against independent numpy oracles (tests/test_aggregation.py).
 """
 from __future__ import annotations
 
@@ -364,3 +383,168 @@ def foolsgold_update(global_params: Any, stacked_grads: Any,
     new_params, _ = sgd_step(global_params, scaled, zeros, lr, momentum,
                              weight_decay)
     return FoolsGoldResult(new_params, FoolsGoldState(memory), wv, alpha)
+
+
+# ------------------------------------------------------- Krum / multi-Krum
+# Sentinels for the masked geometry: finite (inf-free) so a degenerate
+# survivor set still sorts deterministically — an excluded client's score
+# (_EXCLUDED) always exceeds any survivor's, even the 1-survivor case whose
+# score is a sum of _FAR pair distances. Both fit comfortably in f32.
+_FAR = jnp.float32(1e30)       # pair distance to/from an excluded client
+_EXCLUDED = jnp.float32(1e35)  # score of an excluded client
+
+
+class KrumResult(NamedTuple):
+    new_state: Any
+    wv: jax.Array      # [C] applied weights: 1/m_eff for selected, else 0
+    scores: jax.Array  # [C] Krum scores (_EXCLUDED for masked-out clients)
+
+
+def _ones_mask(tree: Any) -> jax.Array:
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return jnp.ones((leaf.shape[0],), jnp.float32)
+
+
+def krum_update(global_state: Any, stacked_deltas: Any, eta: float,
+                num_selected: int, byz_f: int,
+                mask: jax.Array | None = None, dp_sigma: float = 0.0,
+                rng: jax.Array | None = None) -> KrumResult:
+    """Krum / multi-Krum (Blanchard et al., NeurIPS 2017) over survivors.
+
+    score_i = Σ of the n−f−2 smallest squared distances from client i to the
+    other survivors (n = survivor count, f = `byz_f`); the `num_selected`
+    lowest-scoring survivors are averaged and applied as η · mean — m=1 is
+    classic Krum, m>1 multi-Krum. The neighbor count is clipped to
+    [1, n−1] so undersized survivor sets (n < f+3) degrade to
+    nearest-neighbor scoring instead of an invalid slice.
+
+    `mask` ([C], optional): survivor-mask contract — excluded rows are
+    where-zeroed, their pair distances pinned to a far sentinel (never a
+    nearest neighbor), their scores pinned above every survivor's, and the
+    selection size shrinks to min(num_selected, n). mask=None runs the same
+    program with an all-ones mask (dense reduction is structural)."""
+    if mask is None:
+        mask_f = _ones_mask(stacked_deltas)
+    else:
+        mask_f = (mask > 0).astype(jnp.float32)
+        stacked_deltas = survivor_sanitize(stacked_deltas, mask)
+    pts = flatten_stacked(stacked_deltas)                        # [C, P]
+    C = pts.shape[0]
+    sq_norms = jnp.sum(jnp.square(pts), axis=1)                  # [C]
+    gram = pts @ pts.T
+    d2 = sq_norms[:, None] + sq_norms[None, :] - 2.0 * gram
+    d2 = jnp.maximum(d2, 0.0)
+    alive = mask_f > 0
+    valid_pair = (alive[:, None] & alive[None, :]
+                  & ~jnp.eye(C, dtype=bool))
+    d2 = jnp.where(valid_pair, d2, _FAR)
+    n_alive = jnp.sum(mask_f)
+    # n − f − 2 closest peers, clipped to the survivors actually available
+    nb = jnp.clip(n_alive - byz_f - 2.0, 1.0,
+                  jnp.maximum(n_alive - 1.0, 1.0)).astype(jnp.int32)
+    d2_sorted = jnp.sort(d2, axis=1)                             # [C, C]
+    near = jnp.arange(C)[None, :] < nb                           # [C, C]
+    scores = jnp.sum(jnp.where(near, d2_sorted, 0.0), axis=1)
+    scores = jnp.where(alive, scores, _EXCLUDED)
+    m_eff = jnp.clip(jnp.int32(num_selected), 1,
+                     jnp.maximum(n_alive.astype(jnp.int32), 1))
+    rank = jnp.argsort(jnp.argsort(scores))                      # stable
+    sel = (rank < m_eff) & alive
+    wv = sel.astype(jnp.float32) / m_eff.astype(jnp.float32)
+
+    def upd(g, d):
+        chosen = jnp.sum(_bc_mask(wv, d) * d.astype(jnp.float32), axis=0)
+        return (g + eta * chosen.astype(g.dtype)).astype(g.dtype)
+
+    new_state = jax.tree_util.tree_map(upd, global_state, stacked_deltas)
+    if dp_sigma and rng is not None:
+        noise = dp_noise_like(rng, new_state, dp_sigma)
+        new_state = jax.tree_util.tree_map(lambda s, n: s + n.astype(s.dtype),
+                                           new_state, noise)
+    return KrumResult(new_state, wv, scores)
+
+
+# ------------------------------------- coordinate-wise trimmed mean / median
+class CoordwiseResult(NamedTuple):
+    new_state: Any
+    wv: jax.Array  # [C] uniform survivor weights (the recorded per-client
+                   # contribution; coordinate-wise rules have no single
+                   # per-client scalar weight)
+
+
+def _sorted_survivor_columns(stacked_deltas: Any,
+                             mask_f: jax.Array) -> Tuple[jax.Array,
+                                                         jax.Array]:
+    """Columns of the [C, P] survivor matrix sorted ascending with excluded
+    rows pushed past the survivors (+inf), plus the survivor count. Rows
+    [0, n) of each sorted column are exactly the survivor values."""
+    pts = flatten_stacked(stacked_deltas)
+    pts = jnp.where(mask_f[:, None] > 0, pts, jnp.inf)
+    return jnp.sort(pts, axis=0), jnp.sum(mask_f)
+
+
+def trimmed_mean_update(global_state: Any, stacked_deltas: Any, eta: float,
+                        beta: float, mask: jax.Array | None = None,
+                        dp_sigma: float = 0.0,
+                        rng: jax.Array | None = None) -> CoordwiseResult:
+    """Coordinate-wise β-trimmed mean (Yin et al., ICML 2018): per
+    coordinate, drop the k = ⌊β·n⌋ smallest and k largest survivor values
+    (k clipped so at least one value remains) and average the rest; apply
+    the trimmed mean with η. Survivor-mask contract as in
+    :func:`krum_update`."""
+    if mask is None:
+        mask_f = _ones_mask(stacked_deltas)
+    else:
+        mask_f = (mask > 0).astype(jnp.float32)
+        stacked_deltas = survivor_sanitize(stacked_deltas, mask)
+    pts_sorted, n_alive = _sorted_survivor_columns(stacked_deltas, mask_f)
+    n_i = n_alive.astype(jnp.int32)
+    k = jnp.minimum(jnp.floor(beta * n_alive).astype(jnp.int32),
+                    (n_i - 1) // 2)
+    row = jnp.arange(pts_sorted.shape[0])[:, None]               # [C, 1]
+    keep = (row >= k) & (row < n_i - k)
+    kept = jnp.sum(jnp.where(keep, pts_sorted, 0.0), axis=0)
+    count = jnp.maximum(n_alive - 2.0 * k.astype(jnp.float32), 1.0)
+    mean_vec = kept / count                                      # [P]
+    update_tree = unflatten_like(mean_vec * eta, stacked_deltas)
+    new_state = jax.tree_util.tree_map(
+        lambda g, u: (g + u.astype(g.dtype)).astype(g.dtype),
+        global_state, update_tree)
+    if dp_sigma and rng is not None:
+        noise = dp_noise_like(rng, new_state, dp_sigma)
+        new_state = jax.tree_util.tree_map(lambda s, n: s + n.astype(s.dtype),
+                                           new_state, noise)
+    return CoordwiseResult(new_state, mask_f / jnp.maximum(n_alive, 1.0))
+
+
+def coordinate_median_update(global_state: Any, stacked_deltas: Any,
+                             eta: float, mask: jax.Array | None = None,
+                             dp_sigma: float = 0.0,
+                             rng: jax.Array | None = None) -> CoordwiseResult:
+    """Coordinate-wise survivor median (Yin et al., ICML 2018), even counts
+    averaging the two central values (numpy's convention); applied with η.
+    Survivor-mask contract as in :func:`krum_update`."""
+    if mask is None:
+        mask_f = _ones_mask(stacked_deltas)
+    else:
+        mask_f = (mask > 0).astype(jnp.float32)
+        stacked_deltas = survivor_sanitize(stacked_deltas, mask)
+    pts_sorted, n_alive = _sorted_survivor_columns(stacked_deltas, mask_f)
+    n_i = jnp.maximum(n_alive.astype(jnp.int32), 1)
+    lo = (n_i - 1) // 2
+    hi = n_i // 2
+    P = pts_sorted.shape[1]
+    lo_vals = jnp.take_along_axis(
+        pts_sorted, jnp.full((1, P), lo, jnp.int32), axis=0)[0]
+    hi_vals = jnp.take_along_axis(
+        pts_sorted, jnp.full((1, P), hi, jnp.int32), axis=0)[0]
+    med = 0.5 * (lo_vals + hi_vals)                              # [P]
+    update_tree = unflatten_like(med * eta, stacked_deltas)
+    new_state = jax.tree_util.tree_map(
+        lambda g, u: (g + u.astype(g.dtype)).astype(g.dtype),
+        global_state, update_tree)
+    if dp_sigma and rng is not None:
+        noise = dp_noise_like(rng, new_state, dp_sigma)
+        new_state = jax.tree_util.tree_map(lambda s, n: s + n.astype(s.dtype),
+                                           new_state, noise)
+    return CoordwiseResult(new_state, mask_f / jnp.maximum(n_alive, 1.0))

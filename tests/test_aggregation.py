@@ -195,3 +195,123 @@ def test_foolsgold_memory_across_rounds():
     wv = np.asarray(res.wv)
     assert wv[2] < 0.01 and wv[3] < 0.01
     assert wv[0] > 0.5 and wv[1] > 0.5
+
+
+# --------------------------------------- Krum / trimmed mean / coord median
+def _numpy_krum(points, m, f):
+    """Independent oracle for Blanchard et al.'s (multi-)Krum over a dense
+    [n, P] point set: score = sum of the n-f-2 smallest squared distances
+    (clipped to [1, n-1] neighbors), select the m lowest scores, average."""
+    n = points.shape[0]
+    d2 = ((points[:, None, :] - points[None, :, :]) ** 2).sum(-1)
+    nb = int(np.clip(n - f - 2, 1, n - 1))
+    scores = np.array([np.sort(np.delete(d2[i], i))[:nb].sum()
+                       for i in range(n)])
+    sel = np.argsort(scores, kind="stable")[:m]
+    return scores, sel, points[sel].mean(0)
+
+
+@pytest.mark.parametrize("m,f", [(1, 0), (2, 1), (3, 2)])
+def test_krum_matches_numpy_oracle(m, f):
+    rng = np.random.RandomState(7)
+    g = _rand_tree(rng)
+    deltas = _rand_tree(rng, batch=7)
+    res = agg.krum_update(g, jax.tree_util.tree_map(jnp.asarray, deltas),
+                          eta=0.5, num_selected=m, byz_f=f)
+    points = np.stack([_flat([deltas["bn"]["mean"][i],
+                              deltas["dense"]["bias"][i],
+                              deltas["dense"]["kernel"][i]])
+                       for i in range(7)]).astype(np.float64)
+    exp_scores, exp_sel, exp_mean = _numpy_krum(points, m, f)
+    np.testing.assert_allclose(np.asarray(res.scores), exp_scores,
+                               rtol=1e-4, atol=1e-5)
+    got_sel = np.flatnonzero(np.asarray(res.wv) > 0)
+    assert sorted(got_sel) == sorted(exp_sel)
+    np.testing.assert_allclose(np.asarray(res.wv)[got_sel], 1.0 / m)
+    got = _flat([np.asarray(res.new_state["bn"]["mean"]),
+                 np.asarray(res.new_state["dense"]["bias"]),
+                 np.asarray(res.new_state["dense"]["kernel"])])
+    exp = _flat([g["bn"]["mean"], g["dense"]["bias"],
+                 g["dense"]["kernel"]]) + 0.5 * exp_mean
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_krum_outlier_rejected():
+    rng = np.random.RandomState(8)
+    deltas = _rand_tree(rng, batch=6)
+    g = jax.tree_util.tree_map(lambda l: np.zeros_like(l[0]), deltas)
+    # one blown-up client far from the benign cluster
+    deltas["dense"]["kernel"][5] *= 1e4
+    res = agg.krum_update(g, jax.tree_util.tree_map(jnp.asarray, deltas),
+                          eta=1.0, num_selected=2, byz_f=1)
+    assert np.asarray(res.wv)[5] == 0.0
+
+
+@pytest.mark.parametrize("beta", [0.0, 0.2, 0.4])
+def test_trimmed_mean_matches_numpy_oracle(beta):
+    rng = np.random.RandomState(9)
+    g = _rand_tree(rng)
+    deltas = _rand_tree(rng, batch=6)
+    res = agg.trimmed_mean_update(
+        g, jax.tree_util.tree_map(jnp.asarray, deltas), eta=0.3, beta=beta)
+    n = 6
+    k = min(int(np.floor(beta * n)), (n - 1) // 2)
+    for p0, p1 in [("dense", "kernel"), ("dense", "bias"), ("bn", "mean")]:
+        vals = np.sort(deltas[p0][p1].astype(np.float64), axis=0)
+        tm = vals[k:n - k].mean(0)
+        np.testing.assert_allclose(np.asarray(res.new_state[p0][p1]),
+                                   g[p0][p1] + 0.3 * tm, rtol=1e-4,
+                                   atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.wv), np.full(6, 1.0 / 6),
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [5, 6])
+def test_coordinate_median_matches_numpy(n):
+    rng = np.random.RandomState(10)
+    g = _rand_tree(rng)
+    deltas = _rand_tree(rng, batch=n)
+    res = agg.coordinate_median_update(
+        g, jax.tree_util.tree_map(jnp.asarray, deltas), eta=1.0)
+    for p0, p1 in [("dense", "kernel"), ("dense", "bias"), ("bn", "mean")]:
+        med = np.median(deltas[p0][p1].astype(np.float64), axis=0)
+        np.testing.assert_allclose(np.asarray(res.new_state[p0][p1]),
+                                   g[p0][p1] + med, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("rule", ["krum", "trim", "median"])
+def test_masked_rule_equals_dense_on_survivor_subset(rule):
+    """The survivor-mask contract: running the masked rule over C clients
+    with a mask selecting a subset must equal the dense rule over just that
+    subset — excluded rows (even NaN/Inf-poisoned ones) cannot leak into
+    the geometry, scores, or the applied update."""
+    rng = np.random.RandomState(11)
+    g = _rand_tree(rng)
+    deltas = _rand_tree(rng, batch=7)
+    mask_np = np.array([1, 0, 1, 1, 0, 1, 1], bool)
+    # quarantined payloads may be non-finite — exclusion must select
+    deltas["dense"]["kernel"][1] = np.nan
+    deltas["bn"]["mean"][4] = np.inf
+    sub = jax.tree_util.tree_map(lambda l: jnp.asarray(l[mask_np]), deltas)
+    full = jax.tree_util.tree_map(jnp.asarray, deltas)
+    mask = jnp.asarray(mask_np)
+    if rule == "krum":
+        rm = agg.krum_update(g, full, 0.5, 2, 1, mask=mask)
+        rd = agg.krum_update(g, sub, 0.5, 2, 1)
+        np.testing.assert_allclose(
+            np.asarray(rm.scores)[mask_np], np.asarray(rd.scores),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(rm.wv)[mask_np], np.asarray(rd.wv), rtol=1e-6)
+        assert (np.asarray(rm.wv)[~mask_np] == 0).all()
+    elif rule == "trim":
+        rm = agg.trimmed_mean_update(g, full, 0.5, 0.2, mask=mask)
+        rd = agg.trimmed_mean_update(g, sub, 0.5, 0.2)
+    else:
+        rm = agg.coordinate_median_update(g, full, 0.5, mask=mask)
+        rd = agg.coordinate_median_update(g, sub, 0.5)
+    for p0, p1 in [("dense", "kernel"), ("dense", "bias"), ("bn", "mean")]:
+        got = np.asarray(rm.new_state[p0][p1])
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, np.asarray(rd.new_state[p0][p1]),
+                                   rtol=1e-5, atol=1e-6)

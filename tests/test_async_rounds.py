@@ -1,0 +1,260 @@
+"""Buffered-asynchronous federation (fl/async_rounds.py): the sync-reduction
+parity keystone, staleness-weight units, arrival-plan determinism, the
+partial-buffer padded merge, and buffer checkpoint/resume continuity. The
+reference-scale streaming rehearsal is slow-marked."""
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.async_rounds import ArrivalProcess, staleness_weights
+from dba_mod_tpu.fl.experiment import Experiment
+
+BASE = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=3, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False, random_seed=1)
+
+# wall-clock keys never compared, plus the async-only extras a sync row
+# does not carry — everything else must match bit-for-bit at K == C
+VOLATILE = {"time", "round_time", "dispatch_time", "finalize_time"}
+ASYNC_ONLY = {"mode", "buffer_occupancy", "staleness_mean", "staleness_max",
+              "waves_dispatched", "arrivals_total", "virtual_time"}
+
+
+def _rows(exp, drop=()):
+    return [{k: v for k, v in r.items() if k not in VOLATILE | set(drop)}
+            for r in exp.recorder._jsonl_rows]
+
+
+def _bitwise_equal(a, b):
+    la, lb = (jax.tree_util.tree_leaves(t) for t in (a, b))
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+# ----------------------------------------------------------- unit: weights
+def test_staleness_weight_units():
+    s = np.array([0, 1, 2, 5], np.float32)
+    np.testing.assert_array_equal(
+        staleness_weights(s, "none", 0.5), np.ones(4, np.float32))
+    np.testing.assert_allclose(
+        staleness_weights(s, "polynomial", 0.5),
+        (1.0 + s) ** -0.5, rtol=1e-6)
+    np.testing.assert_allclose(
+        staleness_weights(s, "exponential", 0.7), 0.7 ** s, rtol=1e-6)
+    # fresh updates always carry full weight
+    for w in ("none", "polynomial", "exponential"):
+        assert staleness_weights(np.zeros(1), w, 0.5)[0] == 1.0
+    with pytest.raises(ValueError):
+        staleness_weights(s, "inverse", 0.5)
+
+
+# ----------------------------------------------------- unit: arrival plans
+def test_arrival_plans_deterministic_per_seed():
+    a = ArrivalProcess(seed=7, rate=2.0, jitter=0.5, straggler_tail=0.3,
+                      straggler_factor=10.0)
+    b = ArrivalProcess(seed=7, rate=2.0, jitter=0.5, straggler_tail=0.3,
+                      straggler_factor=10.0)
+    for wave in (0, 1, 5):
+        np.testing.assert_array_equal(a.delays(wave, 16), b.delays(wave, 16))
+    # distinct waves and distinct seeds give distinct plans
+    assert not np.array_equal(a.delays(0, 16), a.delays(1, 16))
+    c = ArrivalProcess(seed=8, rate=2.0, jitter=0.5, straggler_tail=0.3,
+                      straggler_factor=10.0)
+    assert not np.array_equal(a.delays(0, 16), c.delays(0, 16))
+
+
+def test_arrival_straggler_tail_stretches_delays():
+    fast = ArrivalProcess(seed=1, rate=1.0, jitter=0.0, straggler_tail=0.0,
+                          straggler_factor=10.0)
+    slow = ArrivalProcess(seed=1, rate=1.0, jitter=0.0, straggler_tail=1.0,
+                          straggler_factor=10.0)
+    df, ds = fast.delays(0, 64), slow.delays(0, 64)
+    # tail draw consumes RNG after the exponentials, so the base delays
+    # match and every straggler is exactly factor× slower
+    np.testing.assert_allclose(ds, df * 10.0, rtol=1e-12)
+    assert ArrivalProcess(seed=1, rate=4.0, jitter=0.0, straggler_tail=0.0,
+                          straggler_factor=1.0).delays(0, 512).mean() < \
+        fast.delays(0, 512).mean()
+    with pytest.raises(ValueError):
+        ArrivalProcess(seed=0, rate=0.0, jitter=0.0, straggler_tail=0.0,
+                       straggler_factor=1.0)
+
+
+# ------------------------------------------------- keystone: sync reduction
+def test_async_k_equals_c_reduces_bit_exactly_to_sync():
+    """buffer_k == no_models, staleness 0: the streaming engine must
+    reproduce the synchronous run bit-for-bit — metrics.jsonl rows
+    (modulo wall clocks and async-only keys), every recorder CSV stream,
+    and the final global model. Arrival knobs deliberately non-trivial:
+    within-wave arrival ORDER cannot matter because the merge sorts its
+    buffer by (wave, lane)."""
+    es = Experiment(Params.from_dict(BASE), save_results=False)
+    es.run()
+    ea = Experiment(Params.from_dict(dict(
+        BASE, mode="async", arrival_rate=3.0, arrival_jitter=0.7,
+        straggler_tail=0.25, straggler_factor=6.0)), save_results=False)
+    ra = ea.run()
+    assert ra["staleness_max"] == 0.0       # full-cohort merges: no overlap
+    assert _rows(es) == _rows(ea, drop=ASYNC_ONLY)
+    assert es.recorder.train_result == ea.recorder.train_result
+    assert es.recorder.test_result == ea.recorder.test_result
+    assert _bitwise_equal(es.global_vars, ea.global_vars)
+
+
+# ----------------------------------------------- partial-buffer padded merge
+def test_partial_buffer_merges_padded_to_k():
+    """Occupancy < K (the graceful-stop flush path) runs through the same
+    compiled merge: inert zero-padding lanes, occupancy mask, divisor = the
+    present updates."""
+    e = Experiment(Params.from_dict(dict(
+        BASE, mode="async", buffer_k=4, async_steps=2)), save_results=False)
+    from dba_mod_tpu.fl.async_rounds import AsyncDriver
+    d = AsyncDriver(e)
+    d._fill_buffer()
+    d._buffer = d._buffer[:1]               # strand 3 arrivals in flight
+    r1 = d._merge_and_record()
+    assert r1["buffer_occupancy"] == 1
+    d._fill_buffer()
+    r2 = d._merge_and_record()
+    assert r2["buffer_occupancy"] == 4
+    rows = e.recorder._jsonl_rows
+    assert [r["epoch"] for r in rows] == [1, 2]
+    assert np.isfinite([r["global_acc"] for r in rows]).all()
+
+
+# --------------------------------------------------- checkpoint / resume
+def test_buffer_checkpoint_resume_is_bit_identical(tmp_path):
+    """Kill between merges (simulated by dropping the Experiment after a
+    capped run), `--resume auto`: the aux-sidecar async_state restores the
+    arrival heap, buffer, and live cohorts, and the continued metrics
+    stream is bit-identical to the uninterrupted run — stragglers carried
+    across the kill included."""
+    cfg = dict(BASE, epochs=6, save_model=True, mode="async", buffer_k=2,
+               arrival_rate=2.0, arrival_jitter=0.6, straggler_tail=0.25,
+               straggler_factor=4.0, staleness_weighting="polynomial",
+               async_steps=8, random_seed=3)
+
+    def rows(folder):
+        drop = VOLATILE | {"virtual_time"}
+        with open(Path(folder) / "metrics.jsonl") as f:
+            return [{k: v for k, v in json.loads(l).items() if k not in drop}
+                    for l in f if l.strip()]
+
+    ref = Experiment(Params.from_dict(dict(
+        cfg, run_dir=str(tmp_path / "ref"))), save_results=True)
+    ref.run()
+    a = Experiment(Params.from_dict(dict(
+        cfg, run_dir=str(tmp_path / "ab"), async_steps=4)),
+        save_results=True)
+    a.run()
+    folder = a.folder
+    del a
+    b = Experiment(Params.from_dict(dict(
+        cfg, run_dir=str(tmp_path / "ab"), resumed_model="auto")),
+        save_results=True)
+    assert str(b.folder) == str(folder)     # same run folder, not a new one
+    assert (b._resume_aux or {}).get("async_state") is not None
+    b.run()
+    got, want = rows(folder), rows(ref.folder)
+    assert [r["epoch"] for r in got] == list(range(1, 9))
+    assert got == want
+
+
+def test_model_only_resume_restarts_stream_with_warning(tmp_path, caplog):
+    """A checkpoint without the async_state sidecar (e.g. one written by a
+    pretrain run) must still resume: model-only, empty buffer, loud
+    warning — never a crash."""
+    cfg = dict(BASE, save_model=True, mode="async", buffer_k=2,
+               async_steps=4, run_dir=str(tmp_path / "runs"))
+    a = Experiment(Params.from_dict(dict(cfg, async_steps=2)),
+                   save_results=True)
+    a.run()
+    folder = a.folder
+    del a
+    # strip the streaming state out of every snapshot's sidecar (re-writing
+    # the manifest so the slimmer sidecar still verifies — what a
+    # pretrain-written checkpoint looks like)
+    from dba_mod_tpu import checkpoint as ckpt
+    for snap in (folder / "model_last.pt.tar",
+                 folder / "model_last.pt.tar.best"):
+        aux = ckpt.load_aux_state(snap)
+        if aux is not None:
+            aux.pop("async_state", None)
+            ckpt.save_aux_state(snap, aux)
+            ckpt.write_manifest(snap, int(aux["epoch"]))
+    import logging
+    lg = logging.getLogger("async_rounds")
+    lg.addHandler(caplog.handler)
+    try:
+        with caplog.at_level("WARNING", logger="async_rounds"):
+            b = Experiment(Params.from_dict(dict(cfg, resumed_model="auto")),
+                           save_results=True)
+            b.run()
+    finally:
+        lg.removeHandler(caplog.handler)
+    assert any("buffer state lost" in r.getMessage()
+               for r in caplog.records)
+    with open(Path(folder) / "metrics.jsonl") as f:
+        epochs = [json.loads(l)["epoch"] for l in f if l.strip()]
+    assert epochs == [1, 2, 3, 4]           # stream restarted, no dupes
+
+
+# ------------------------------------------------------------ config guards
+def test_sync_mode_ignores_async_knobs():
+    """mode: sync is a strict no-op for every async knob — same dispatch
+    path, bit-identical rows whether or not the knobs are set."""
+    ea = Experiment(Params.from_dict(dict(
+        BASE, epochs=2, buffer_k=3, staleness_weighting="polynomial",
+        arrival_rate=9.0, straggler_tail=0.9)), save_results=False)
+    ea.run()
+    eb = Experiment(Params.from_dict(dict(BASE, epochs=2)),
+                    save_results=False)
+    eb.run()
+    assert _rows(ea) == _rows(eb)
+    assert _bitwise_equal(ea.global_vars, eb.global_vars)
+
+
+def test_async_config_rejections():
+    with pytest.raises(ValueError, match="foolsgold"):
+        Params.from_dict(dict(BASE, mode="async",
+                              aggregation_methods="foolsgold"))
+    with pytest.raises(ValueError, match="aggr_epoch_interval"):
+        Params.from_dict(dict(BASE, mode="async", aggr_epoch_interval=2))
+    with pytest.raises(ValueError, match="mode"):
+        Params.from_dict(dict(BASE, mode="streaming"))
+    with pytest.raises(ValueError, match="staleness_weighting"):
+        Params.from_dict(dict(BASE, staleness_weighting="inverse"))
+
+
+# ------------------------------------------------------- slow: rehearsal
+@pytest.mark.slow
+def test_async_streaming_rehearsal_100_participants():
+    """Reference-scale streaming soak: 100 participants, 10-client cohorts,
+    5-update buffer, faults as arrival events, staleness weighting on.
+    Accuracy must stay finite, every merge at full occupancy, staleness
+    actually exercised, and per-client rows recorded for resolved waves."""
+    cfg = dict(
+        BASE, epochs=10, no_models=10, number_of_total_participants=100,
+        synthetic_train_size=4000, mode="async", buffer_k=5,
+        staleness_weighting="polynomial", staleness_alpha=0.5,
+        arrival_rate=2.0, arrival_jitter=0.8, straggler_tail=0.2,
+        straggler_factor=8.0, async_steps=20, fault_injection=True,
+        fault_dropout_prob=0.05, fault_stale_prob=0.1, fault_seed=11)
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    r = e.run()
+    rows = e.recorder._jsonl_rows
+    assert len(rows) == 20
+    assert all(row["buffer_occupancy"] == 5 for row in rows)
+    assert np.isfinite([row["global_acc"] for row in rows]).all()
+    assert max(row["staleness_max"] for row in rows) > 0
+    assert sum(row["n_dropped"] for row in rows) > 0
+    assert np.isfinite(r["global_acc"])
+    assert len(e.recorder.train_result) > 0
