@@ -3,7 +3,7 @@
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
 .PHONY: tier1 test-slow trace crash-smoke elastic-smoke forensics-smoke \
-  async-smoke
+  async-smoke chaos-soak chaos-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -50,6 +50,21 @@ elastic-smoke:
 # assert aggregation steps 1..N land exactly once in the same folder.
 async-smoke:
 	bash scripts/async_smoke.sh
+
+# Self-healing soak (README "Self-healing federation"): sync + async lanes
+# under the full compound fault schedule (dropout / corruption / blowup /
+# stale replay / host loss) while the harness SIGTERMs/SIGKILLs the
+# process at seeded instants and flips bytes in committed checkpoints.
+# Asserts: one run folder per lane, steps 1..N exactly once across every
+# resume, finite metrics, verified final checkpoint, exit codes inside the
+# {0, 75, 76, 77} contract. CHAOS_SEED / CHAOS_KILLS / CHAOS_LANES
+# override the schedule.
+chaos-soak:
+	bash scripts/chaos_soak.sh
+
+# CI-sized slice of the soak: the async lane only, one seeded kill cycle.
+chaos-smoke:
+	CHAOS_KILLS=1 CHAOS_LANES=async bash scripts/chaos_soak.sh
 
 # Defense-forensics drill (README "Defense forensics"): tiny FoolsGold
 # sybil run with `forensics: true`, assert forensics.jsonl +

@@ -610,6 +610,11 @@ def main() -> int:
                 "cohort_clients": int(aexp.params["no_models"]),
                 "staleness_weighting": str(
                     aexp.params["staleness_weighting"]),
+                # self-healing observability (driver counters over the
+                # timed window + warmup): virtual-time merge latency p95,
+                # admission-control high-water, and the starvation/TTL
+                # drop counts — all zero with the knobs at defaults
+                "health": drv.stats(),
                 "workload": "headline config through the buffered-async "
                             "engine: 10-client cohorts, merge every 5 "
                             "arrivals, polynomial staleness, jittered "

@@ -187,6 +187,51 @@ _DEFAULTS: Dict[str, Any] = {
                                    # from epochs (epochs*no_models/buffer_k
                                    # — the same total client-update budget
                                    # as the sync run)
+    # --- self-healing server loop (fl/async_rounds.py, fl/experiment.py;
+    #     README "Self-healing federation"). Every knob here is a strict
+    #     bit-identical no-op at its default.
+    "merge_timeout_v": 0.0,        # virtual-seconds merge deadline: fire a
+                                   # partial merge when the oldest buffered
+                                   # arrival has waited this long and >=
+                                   # merge_min_k updates are buffered
+                                   # (inert-lane padding handles the short
+                                   # batch); 0 = K-arrivals-only merges
+    "merge_min_k": 1,              # minimum buffered updates for a
+                                   # deadline-triggered partial merge
+    "starvation_policy": "abort",  # after 200 consecutive empty cohorts:
+                                   # "abort" (raise — the pre-existing
+                                   # behaviour), "carry" (record a carried
+                                   # no-op step and keep going), "wait"
+                                   # (keep drawing cohorts indefinitely;
+                                   # the watchdog is the backstop)
+    "max_outstanding_waves": 0,    # admission control: stop dispatching
+                                   # new waves while this many are still
+                                   # resident (straggler tails otherwise
+                                   # grow _waves unboundedly); 0 = no cap
+    "arrival_ttl_v": 0.0,          # expire heap arrivals older (in virtual
+                                   # seconds) than this at pop time — the
+                                   # update never reaches the buffer and
+                                   # its lane is freed; 0 = never expire
+    "model_health_check": False,   # jitted post-merge sentinel in BOTH
+                                   # engines: all-finite params + update
+                                   # norm vs a trailing EMA band; an
+                                   # unhealthy merge rolls back to the
+                                   # last-good ring and re-merges the same
+                                   # buffer with escalated screening
+    "health_norm_band": 0.0,       # flag a merge whose update norm exceeds
+                                   # band × trailing-EMA(update norm);
+                                   # 0 disables the norm band (the finite
+                                   # check still runs when the sentinel is
+                                   # on)
+    "health_ema_alpha": 0.1,       # EMA smoothing for the trailing update
+                                   # norm (new = a*obs + (1-a)*old)
+    "health_warmup_merges": 3,     # merges before the norm band arms (the
+                                   # EMA needs history; finite check is
+                                   # active from merge 1)
+    "rollback_ring": 0,            # last-good in-memory model versions
+                                   # kept for health rollback; 0 = ring off
+                                   # (an unhealthy merge then only skips +
+                                   # carries, it cannot roll back)
     # --- fault model & robustness (fl/faults.py, README "Fault model") ---
     "fault_injection": False,      # master switch for the deterministic
                                    # fault harness (fl/faults.py); off =
@@ -382,6 +427,28 @@ class Params:
             raise ValueError("straggler_factor must be >= 1")
         if int(merged["async_steps"]) < 0:
             raise ValueError("async_steps must be >= 0")
+        if float(merged["merge_timeout_v"]) < 0:
+            raise ValueError("merge_timeout_v must be >= 0 (0 = off)")
+        if int(merged["merge_min_k"]) < 1:
+            raise ValueError("merge_min_k must be >= 1")
+        if merged["starvation_policy"] not in ("wait", "carry", "abort"):
+            raise ValueError(
+                "starvation_policy must be 'wait'/'carry'/'abort', got "
+                f"{merged['starvation_policy']!r}")
+        if int(merged["max_outstanding_waves"]) < 0:
+            raise ValueError("max_outstanding_waves must be >= 0 (0 = no cap)")
+        if float(merged["arrival_ttl_v"]) < 0:
+            raise ValueError("arrival_ttl_v must be >= 0 (0 = never expire)")
+        if float(merged["health_norm_band"]) < 0:
+            raise ValueError("health_norm_band must be >= 0 (0 = off)")
+        alpha_h = float(merged["health_ema_alpha"])
+        if not 0.0 < alpha_h <= 1.0:
+            raise ValueError(
+                f"health_ema_alpha must be in (0, 1], got {alpha_h}")
+        if int(merged["health_warmup_merges"]) < 0:
+            raise ValueError("health_warmup_merges must be >= 0")
+        if int(merged["rollback_ring"]) < 0:
+            raise ValueError("rollback_ring must be >= 0 (0 = ring off)")
         if merged["mode"] == "async":
             # the async driver's constraints, rejected at validation so a
             # bad combo fails before data loading: FoolsGold's cross-round

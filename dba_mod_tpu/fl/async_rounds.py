@@ -70,6 +70,32 @@ clock, arrival heap, buffer, and the delta payloads of every wave still
 referenced) rides the PR-4 aux sidecar under the ``async_state`` key —
 ``kill -9`` between merges resumes bit-exactly from the last committed
 merge (tests/test_async_rounds.py).
+
+Self-healing layer (README "Self-healing federation"; every knob a strict
+bit-identical no-op at its default):
+
+  - ``merge_timeout_v`` + ``merge_min_k``: a merge fires on K arrivals OR
+    when the oldest buffered update has waited past the virtual-time
+    deadline with at least ``merge_min_k`` buffered — the padded partial
+    merge is the same compiled program shape.
+  - ``starvation_policy``: what 200 consecutive empty cohorts means —
+    "abort" (the pre-existing RuntimeError), "carry" (record a degraded
+    no-op step and keep going), "wait" (keep drawing cohorts; the
+    watchdog is the backstop). Starved cohorts are counted either way.
+  - ``max_outstanding_waves``: admission control — with the watermark hit
+    and mergeable updates buffered, the driver flushes a partial merge
+    instead of dispatching another cohort. ``arrival_ttl_v`` expires heap
+    entries whose service delay exceeded the TTL; they never reach the
+    buffer.
+  - ``model_health_check``: the shared HealthSentinel (fl/rounds.py) gates
+    every commit — an unhealthy merge re-merges the SAME buffer with
+    escalated screening up to ``max_round_retries`` (the async analog of
+    the sync retry loop; the escalation never recompiles because
+    norm_mult is a traced scalar), then rolls back to the last-good ring
+    (``rollback_ring``) and records the step degraded.
+  - ``min_surviving_clients``: a merge whose screen leaves fewer
+    survivors skips aggregation inside the jitted merge (the same
+    jnp.where carry as the sync round) and records the step degraded.
 """
 from __future__ import annotations
 
@@ -93,6 +119,12 @@ from dba_mod_tpu.fl.state import build_client_tasks
 from dba_mod_tpu.ops import aggregation as agg
 
 logger = logging.getLogger("async_rounds")
+
+# consecutive empty cohorts before the stream counts as starved and
+# starvation_policy decides (abort / wait / carry). Module-level so tests
+# can starve cheaply; the production value is deliberately generous — a
+# fault plan has to zero out 200 cohorts in a row before we give up
+STARVATION_LIMIT = 200
 
 
 def staleness_weights(staleness: np.ndarray, weighting: str,
@@ -161,6 +193,7 @@ class _Wave:
     delta_norms: Any             # [C] device/np
     outstanding: int             # lanes not yet consumed
     recorded: bool = False
+    t_dispatch: float = 0.0      # virtual clock at dispatch (arrival_ttl_v)
 
 
 class AsyncDriver:
@@ -194,6 +227,14 @@ class AsyncDriver:
             logger.warning("async mode does not record per-batch channels; "
                            "vis_train_batch_loss/batch_track_distance rows "
                            "will be absent")
+        # self-healing knobs (README "Self-healing federation") — every
+        # default is a strict bit-identical no-op
+        self.merge_timeout_v = float(p.get("merge_timeout_v", 0.0))
+        self.merge_min_k = int(p.get("merge_min_k", 1))
+        self.starvation_policy = str(p.get("starvation_policy", "abort"))
+        self.max_outstanding = int(p.get("max_outstanding_waves", 0))
+        self.arrival_ttl_v = float(p.get("arrival_ttl_v", 0.0))
+        self._sentinel = exp._sentinel  # shared HealthSentinel or None
         # streaming state
         self.version = 0          # merges applied
         self.wave = 0             # cohorts dispatched
@@ -201,10 +242,19 @@ class AsyncDriver:
         self._seq = 0             # heap tie-break
         self._heap: List[Tuple[float, int, int, int]] = []  # (t, seq, wid, lane)
         self._buffer: List[Tuple[int, int]] = []            # (wid, lane)
+        self._arrival_t: Dict[Tuple[int, int], float] = {}  # buffered → t
         self._waves: Dict[int, _Wave] = {}
         self._pending_dropped = 0
         self._dispatch_wall = 0.0
         self._total_arrivals = 0
+        # self-healing observability (stats() — bench.py's --async lane)
+        self._starved_cohorts = 0
+        self._expired_arrivals = 0
+        self._deadline_merges = 0
+        self._backpressure_hits = 0
+        self._rollbacks = 0
+        self._waves_highwater = 0
+        self._merge_latencies: List[float] = []
         self._merge_fn = self._build_merge_fn()
         fcfg = exp.engine.fault_cfg
         self._perturb_fn = (jax.jit(
@@ -225,15 +275,17 @@ class AsyncDriver:
         exp = self.exp
         hyper = exp.engine.hyper
         screening = exp.engine.screening
-        norm_mult = float(exp.engine.base_norm_mult)
+        min_surv = int(exp.params.get("min_surviving_clients", 1))
         weighting = self.weighting
         K = self.K
         if hyper.aggregation == cfg.AGGR_FOOLSGOLD:  # config.py rejects too
             raise ValueError("foolsgold is stateful per-round and has no "
                              "buffered-async form; pick another rule")
 
-        def merge(global_vars, deltas, nbt, ns, occ, w, rng):
-            # deltas: [K] stacked tree; occ [K] bool occupancy; w [K] f32
+        def merge(global_vars, deltas, nbt, ns, occ, w, rng, norm_mult):
+            # deltas: [K] stacked tree; occ [K] bool occupancy; w [K] f32;
+            # norm_mult a TRACED scalar so health re-merges escalate the
+            # screen without recompiling (the sync retry-loop contract)
             if weighting != "none":
                 deltas = jax.tree_util.tree_map(
                     lambda l: (l * agg._bc_mask(w, l)
@@ -242,8 +294,7 @@ class AsyncDriver:
             mask = occ
             n_quar = jnp.int32(0)
             if screening:
-                surv, _ = screen_client_updates(
-                    deltas, occ, occ, jnp.float32(norm_mult))
+                surv, _ = screen_client_updates(deltas, occ, occ, norm_mult)
                 mask = occ & surv
                 n_quar = jnp.sum((occ & ~surv).astype(jnp.int32))
             sigma = hyper.sigma if hyper.diff_privacy else 0.0
@@ -286,7 +337,18 @@ class AsyncDriver:
                                                  hyper.eta, mask=mask,
                                                  dp_sigma=sigma, rng=rng)
                 new_vars, wv = r.new_state, r.wv
-            return new_vars, wv, alpha, calls, is_updated, n_quar
+            # min_surviving_clients skip-and-carry, the sync round's
+            # degradation ported to the buffered merge: too few surviving
+            # occupied lanes ⇒ the global model is carried unchanged
+            # (jnp.where with a False scalar is a bitwise passthrough, so
+            # the default min_surv=1 path stays bit-identical)
+            n_surv = jnp.sum(mask.astype(jnp.int32))
+            degraded = n_surv < jnp.int32(min_surv)
+            new_vars = jax.tree_util.tree_map(
+                lambda g, a: jnp.where(degraded, g, a), global_vars,
+                new_vars)
+            return (new_vars, wv, alpha, calls, is_updated, n_quar, n_surv,
+                    degraded)
 
         return jax.jit(merge)
 
@@ -316,8 +378,10 @@ class AsyncDriver:
                     "graceful stop honored at the merge boundary after "
                     "step %d (resume with --resume auto)", self.version)
                 break
-            self._fill_buffer()
-            last = self._merge_and_record()
+            if self._fill_buffer():
+                last = self._merge_and_record()
+            else:
+                last = self._carry_starved_step()
             self._save()
             exp.telemetry.mark_warm()
             logger.info(
@@ -335,41 +399,118 @@ class AsyncDriver:
         """Run exactly n merges (bench.py's --async lane), no checkpoints."""
         last: Dict[str, Any] = {}
         for _ in range(n):
-            self._fill_buffer()
-            last = self._merge_and_record()
+            if self._fill_buffer():
+                last = self._merge_and_record()
+            else:
+                last = self._carry_starved_step()
         return last
+
+    def stats(self) -> Dict[str, Any]:
+        """Self-healing observability for bench.py's --async lane: p95
+        virtual merge latency (arrival → merge, virtual seconds) plus the
+        backpressure/starvation counters and the outstanding-waves
+        high-water mark."""
+        lat = sorted(self._merge_latencies)
+        p95 = float(lat[int(0.95 * (len(lat) - 1))]) if lat else 0.0
+        return {"merge_latency_v_p95": p95,
+                "outstanding_waves_highwater": self._waves_highwater,
+                "starved_cohorts": self._starved_cohorts,
+                "expired_arrivals": self._expired_arrivals,
+                "deadline_merges": self._deadline_merges,
+                "backpressure_hits": self._backpressure_hits,
+                "health_rollbacks": self._rollbacks}
 
     def _save(self):
         self.exp.save_model(self.version,
                             extra_aux={"async_state": self._snapshot()})
 
     # ------------------------------------------------------ arrivals / waves
-    def _fill_buffer(self):
-        """Pop arrivals into the buffer until it holds K; dispatch a new
-        cohort whenever the queue drains. Virtual time advances to each
-        consumed arrival."""
+    def _deadline_due(self) -> bool:
+        """True when a merge_timeout_v deadline merge should fire: the
+        oldest buffered update has waited past the deadline (>= merge_min_k
+        buffered) and the next known arrival — if any — lands after it.
+        Firing advances the virtual clock to the deadline instant."""
+        if self.merge_timeout_v <= 0 or len(self._buffer) < self.merge_min_k:
+            return False
+        oldest = self._arrival_t.get(tuple(self._buffer[0]), self.clock)
+        deadline = oldest + self.merge_timeout_v
+        if self._heap and self._heap[0][0] < deadline:
+            return False
+        self.clock = max(self.clock, deadline)
+        return True
+
+    def _expire_arrival(self, t: float, wid: int) -> bool:
+        """arrival_ttl_v: an update whose service delay exceeded the TTL is
+        expired at pop time — it never reaches the buffer, its lane is
+        freed, and a fully-resolved cohort is recorded immediately."""
+        w = self._waves[wid]
+        if t - w.t_dispatch <= self.arrival_ttl_v:
+            return False
+        self._expired_arrivals += 1
+        self.exp.telemetry.counter("async/expired_arrivals").inc()
+        w.outstanding -= 1
+        if w.outstanding == 0 and not w.recorded:
+            self._record_wave_rows(w)
+            del self._waves[wid]
+        return True
+
+    def _fill_buffer(self) -> bool:
+        """Pop arrivals into the buffer until it holds K — or until a
+        merge_timeout_v deadline or max_outstanding_waves backpressure
+        flush fires a partial merge. Dispatches a new cohort whenever the
+        queue drains; virtual time advances to each consumed arrival.
+        Returns True when the buffer should be merged, False when the
+        stream is starved and starvation_policy says to carry a no-op
+        step."""
         exp = self.exp
         empty_waves = 0
         while len(self._buffer) < self.K:
+            if self._deadline_due():
+                self._deadline_merges += 1
+                exp.telemetry.counter("async/deadline_merges").inc()
+                return True
             while not self._heap:
+                if (self.max_outstanding > 0 and self._buffer
+                        and len(self._waves) >= self.max_outstanding):
+                    # admission control: the watermark is hit and we hold
+                    # mergeable updates — flush instead of dispatching
+                    self._backpressure_hits += 1
+                    exp.telemetry.counter("async/backpressure_hits").inc()
+                    return True
                 before = len(self._heap)
                 self._dispatch_wave()
                 if len(self._heap) == before:
                     empty_waves += 1
-                    if empty_waves > 200:
+                    self._starved_cohorts += 1
+                    exp.telemetry.counter("async/starved_cohorts").inc()
+                    if empty_waves > STARVATION_LIMIT:
+                        if self.starvation_policy == "carry":
+                            if self._buffer:
+                                return True  # flush what we hold
+                            return False     # carry a degraded no-op step
+                        if self.starvation_policy == "wait":
+                            # keep drawing cohorts indefinitely; the
+                            # watchdog (watchdog_hard_s) is the backstop
+                            empty_waves = 0
+                            continue
                         raise RuntimeError(
-                            "async arrival queue starved: 200 consecutive "
-                            "cohorts produced no arrivals (fault dropout "
-                            "too aggressive?)")
+                            "async arrival queue starved: "
+                            f"{STARVATION_LIMIT} consecutive cohorts "
+                            "produced no arrivals (fault dropout too "
+                            "aggressive?)")
                 else:
                     empty_waves = 0
             t, _seq, wid, lane = heapq.heappop(self._heap)
+            if self.arrival_ttl_v > 0 and self._expire_arrival(t, wid):
+                continue
             self.clock = max(self.clock, t)
             self._buffer.append((wid, lane))
+            self._arrival_t[(wid, lane)] = self.clock
             self._total_arrivals += 1
             exp.telemetry.counter("async/arrivals").inc()
             exp.telemetry.gauge("async/buffer_occupancy").set(
                 len(self._buffer))
+        return True
 
     def _dispatch_wave(self):
         """Select + train one cohort through the lockstep train program and
@@ -460,11 +601,16 @@ class AsyncDriver:
                 pids=np.asarray(tasks.participant_id),
                 rng_agg=rng_agg, metrics_dev=train.metrics,
                 locals_dev=locals_dev, delta_norms=train.delta_norms,
-                outstanding=int(len(agent_names) - dropped.sum()))
+                outstanding=int(len(agent_names) - dropped.sum()),
+                t_dispatch=self.clock)
             if self._waves[wid].outstanding == 0:
                 # fully dropped cohort: record its train rows now and free it
                 self._record_wave_rows(self._waves[wid])
                 del self._waves[wid]
+        if len(self._waves) > self._waves_highwater:
+            self._waves_highwater = len(self._waves)
+            exp.telemetry.gauge("async/outstanding_waves_highwater").set(
+                self._waves_highwater)
         exp.telemetry.counter("async/waves").inc()
         self._dispatch_wall += time.perf_counter() - t0
 
@@ -496,6 +642,13 @@ class AsyncDriver:
             w = self._waves[wid]
             present = {w.names[ln] for ln in merged_by_wave[wid]}
             adversaries.extend(n for n in w.adv_names if n in present)
+        for e in entries:
+            lat = max(0.0, self.clock - self._arrival_t.pop(e, self.clock))
+            self._merge_latencies.append(lat)
+            exp.telemetry.histogram("async/merge_latency_v").observe(lat)
+        if len(self._merge_latencies) > 100_000:
+            del self._merge_latencies[:-50_000]
+        rolled_back = False
         with exp.telemetry.span("async/merge"):
             deltas, nbt, ns, pids = self._gather(entries)
             staleness = np.array(
@@ -509,9 +662,45 @@ class AsyncDriver:
             occ = np.zeros((self.K,), bool)
             occ[:B] = True
             rng = self._waves[max(w for w, _ in entries)].rng_agg
-            new_vars, wv, alpha, calls, is_updated, n_quar = self._merge_fn(
-                exp.global_vars, deltas, nbt, jnp.asarray(ns),
-                jnp.asarray(occ), jnp.asarray(w_full), rng)
+            vars_before = exp.global_vars
+            # health sentinel loop (async analog of the sync retry loop):
+            # an unhealthy candidate re-merges the SAME buffer with an
+            # escalated norm screen; norm_mult is traced, so no recompile
+            norm_mult: Optional[float] = None
+            retries = 0
+            healthy, unorm = True, 0.0
+            while True:
+                nm = (exp.engine.base_norm_mult if norm_mult is None
+                      else norm_mult)
+                (new_vars, wv, alpha, calls, is_updated, n_quar, n_surv,
+                 degr) = self._merge_fn(
+                    vars_before, deltas, nbt, jnp.asarray(ns),
+                    jnp.asarray(occ), jnp.asarray(w_full), rng,
+                    jnp.float32(nm))
+                if self._sentinel is None:
+                    break
+                healthy, unorm = self._sentinel.check(vars_before, new_vars)
+                if (healthy or not exp.engine.screening
+                        or retries >= exp.max_round_retries):
+                    break
+                retries += 1
+                norm_mult = exp._escalate_norm_mult(nm)
+                logger.warning(
+                    "merge %d: unhealthy aggregate; re-merge %d/%d with "
+                    "norm screen at %.2fx median", step, retries,
+                    exp.max_round_retries, norm_mult)
+            if self._sentinel is not None and not healthy:
+                # retries exhausted (or unscreened): roll back to the
+                # last-good ring and record the step degraded
+                rolled_back = True
+                self._rollbacks += 1
+                exp.telemetry.counter("async/health_rollbacks").inc()
+                new_vars = self._sentinel.rollback_target(vars_before)
+                logger.warning(
+                    "merge %d: unhealthy aggregate after %d re-merges "
+                    "(update norm %.3g vs EMA %.3g); rolled back to "
+                    "last-good model", step, retries, unorm,
+                    self._sentinel.ema)
             globals_dev = exp.engine.global_evals_fn(new_vars)
         exp.global_vars = new_vars
         self.version = step
@@ -521,9 +710,13 @@ class AsyncDriver:
             del self._waves[wid]
         with exp.telemetry.span("async/finalize"):
             t_fin = time.perf_counter()
-            globals_, wv_h, alpha_h, is_upd_h, n_quar_h = jax.device_get(
-                (globals_dev, wv, alpha, is_updated, n_quar))
+            (globals_, wv_h, alpha_h, is_upd_h, n_quar_h,
+             degr_h) = jax.device_get(
+                (globals_dev, wv, alpha, is_updated, n_quar, degr))
         finalize_time = time.perf_counter() - t_fin
+        degraded = bool(degr_h) or rolled_back
+        if self._sentinel is not None and not rolled_back and not degraded:
+            self._sentinel.commit(step, new_vars, unorm)
         exp.last_is_updated = bool(is_upd_h)
         exp.last_global_loss = float(globals_.clean.loss)
         if exp.is_poison_run:
@@ -534,7 +727,7 @@ class AsyncDriver:
         self._dispatch_wall = 0.0
         robust = {"n_quarantined": int(n_quar_h),
                   "n_dropped": self._pending_dropped,
-                  "n_retries": 0, "degraded": False}
+                  "n_retries": retries, "degraded": degraded}
         self._pending_dropped = 0
         extras = {"mode": "async", "buffer_occupancy": B,
                   "staleness_mean": float(staleness.mean()) if B else 0.0,
@@ -548,6 +741,46 @@ class AsyncDriver:
         exp.telemetry.counter("async/updates_merged").inc(B)
         self._flush_merge_telemetry(step, robust, times)
         return {"epoch": step, "agents": names,
+                "global_acc": float(globals_.clean.acc),
+                "backdoor_acc": (float(globals_.poison.acc)
+                                 if exp.is_poison_run else None),
+                **times, **robust, **extras}
+
+    def _carry_starved_step(self) -> Dict[str, Any]:
+        """starvation_policy "carry": the stream produced no arrivals for
+        200 consecutive cohorts and the buffer is empty — consume one merge
+        step as a recorded no-op (model unchanged, row degraded) so a
+        starved run terminates inside its budget instead of aborting."""
+        exp = self.exp
+        t0 = time.perf_counter()
+        step = self.version + 1
+        exp.telemetry.set_epoch(step)
+        globals_dev = exp.engine.global_evals_fn(exp.global_vars)
+        self.version = step
+        globals_ = jax.device_get(globals_dev)
+        exp.last_is_updated = False
+        exp.last_global_loss = float(globals_.clean.loss)
+        if exp.is_poison_run:
+            exp.last_backdoor_acc = float(globals_.poison.acc)
+        times = {"round_time": time.perf_counter() - t0,
+                 "dispatch_time": self._dispatch_wall, "finalize_time": 0.0}
+        self._dispatch_wall = 0.0
+        robust = {"n_quarantined": 0, "n_dropped": self._pending_dropped,
+                  "n_retries": 0, "degraded": True}
+        self._pending_dropped = 0
+        extras = {"mode": "async", "buffer_occupancy": 0,
+                  "staleness_mean": 0.0, "staleness_max": 0.0,
+                  "waves_dispatched": self.wave,
+                  "arrivals_total": self._total_arrivals,
+                  "virtual_time": self.clock}
+        zeros = np.zeros((self.K,), np.float32)
+        self._record_merge(step, [], [], [], globals_, zeros, zeros, times,
+                           robust, extras)
+        exp.telemetry.counter("async/starved_steps").inc()
+        self._flush_merge_telemetry(step, robust, times)
+        logger.warning("merge %d: starved stream carried as a degraded "
+                       "no-op step (starvation_policy: carry)", step)
+        return {"epoch": step, "agents": [],
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
                                  if exp.is_poison_run else None),
@@ -713,6 +946,10 @@ class AsyncDriver:
             t.counter("clients_quarantined").inc(robust["n_quarantined"])
         if robust.get("n_dropped"):
             t.counter("clients_dropped").inc(robust["n_dropped"])
+        if robust.get("n_retries"):
+            t.counter("round_retries").inc(robust["n_retries"])
+        if robust.get("degraded"):
+            t.counter("degraded_rounds").inc()
         t.histogram("round_seconds").observe(times["round_time"])
         t.flush_round(step)
 
@@ -739,10 +976,15 @@ class AsyncDriver:
                 "rng_agg": np.asarray(jax.random.key_data(w.rng_agg)),
                 "metrics": metrics, "locals": locals_,
                 "delta_norms": np.asarray(norms),
-                "outstanding": w.outstanding, "recorded": w.recorded}
+                "outstanding": w.outstanding, "recorded": w.recorded,
+                "t_dispatch": w.t_dispatch}
         return {"version": self.version, "wave": self.wave,
                 "clock": self.clock, "seq": self._seq,
                 "heap": list(self._heap), "buffer": list(self._buffer),
+                "arrival_t": [[wid, lane, t] for (wid, lane), t
+                              in self._arrival_t.items()],
+                "health": (self._sentinel.state()
+                           if self._sentinel is not None else None),
                 "pending_dropped": self._pending_dropped,
                 "total_arrivals": self._total_arrivals, "waves": waves}
 
@@ -767,6 +1009,12 @@ class AsyncDriver:
         self._heap = [tuple(e) for e in st["heap"]]
         heapq.heapify(self._heap)
         self._buffer = [tuple(e) for e in st["buffer"]]
+        # pre-PR sidecars carry no arrival times: buffered entries then get
+        # no deadline credit (t defaults to the restored clock)
+        self._arrival_t = {(int(a), int(b)): float(t)
+                           for a, b, t in st.get("arrival_t", [])}
+        if self._sentinel is not None:
+            self._sentinel.load_state(st.get("health"))
         self._pending_dropped = int(st["pending_dropped"])
         self._total_arrivals = int(st["total_arrivals"])
         for wid, d in st["waves"].items():
@@ -781,7 +1029,8 @@ class AsyncDriver:
                 metrics_dev=d["metrics"], locals_dev=d["locals"],
                 delta_norms=d["delta_norms"],
                 outstanding=int(d["outstanding"]),
-                recorded=bool(d["recorded"]))
+                recorded=bool(d["recorded"]),
+                t_dispatch=float(d.get("t_dispatch", 0.0)))
         logger.info("async resume: merge %d, %d cohorts live, %d buffered, "
                     "%d in flight", self.version, len(self._waves),
                     len(self._buffer), len(self._heap))

@@ -340,6 +340,18 @@ class Experiment:
                              "placed across processes)")
         self.max_round_retries = int(params.get("max_round_retries", 2))
         self.retry_backoff_s = float(params.get("retry_backoff_s", 0.0))
+        # post-merge model-health sentinel (README "Self-healing
+        # federation"): None when off — no program traced, no host sync,
+        # strict no-op. Shared with the async driver so both engines gate
+        # commits through the same EMA band + last-good ring.
+        self._sentinel = None
+        if bool(params.get("model_health_check", False)):
+            from dba_mod_tpu.fl.rounds import HealthSentinel
+            self._sentinel = HealthSentinel(
+                band=float(params.get("health_norm_band", 0.0)),
+                ema_alpha=float(params.get("health_ema_alpha", 0.1)),
+                warmup=int(params.get("health_warmup_merges", 3)),
+                ring_size=int(params.get("rollback_ring", 0)))
         self._fault_key = jax.random.key(self.engine.fault_cfg.seed)
         # last round's submitted deltas (the stale lane's replay source).
         # Checkpointed in the aux sidecar when the lane is on (save_model
@@ -758,12 +770,19 @@ class Experiment:
             new_vars, new_fg, payload = self.engine.round_fn(
                 self.global_vars, self.fg_state, tasks_seq, idx_seq,
                 mask_seq, lane, ns_dev, rng_train, rng_agg)
+            rolled = False
+            if self._sentinel is not None:
+                new_vars, payload, rolled = self._health_gate(
+                    epoch, self.global_vars, new_vars, payload)
+                if rolled:
+                    new_fg = self.fg_state
             self.global_vars = new_vars
             self.fg_state = new_fg
             return RoundInFlight(
                 epoch=epoch, t0=t0, seg_epochs=seg_epochs,
                 agent_names=agent_names, adv_names=adv_names,
                 tasks_list=tasks_list, mask_list=mask_list, payload=payload,
+                forced_degraded=rolled,
                 vars_after=new_vars, fg_after=new_fg,
                 rng_after=self._snapshot_rng())
 
@@ -825,18 +844,26 @@ class Experiment:
             fstats_dev = self.engine.forensic_fn(
                 self.global_vars, result.new_vars, train.deltas,
                 result.num_oracle_calls)
-        self.global_vars = result.new_vars
-        self.fg_state = result.new_fg_state
         track = (bool(params.get("vis_train_batch_loss"))
                  or bool(params.get("batch_track_distance")))
         batch_dev = (train.batch_loss, train.batch_dist) if track else None
         payload = (locals_dev, globals_dev, train.metrics, train.delta_norms,
                    result.wv, result.alpha, batch_dev, result.is_updated,
                    seg_locals_dev, None, fstats_dev)
+        new_vars, new_fg = result.new_vars, result.new_fg_state
+        rolled = False
+        if self._sentinel is not None:
+            new_vars, payload, rolled = self._health_gate(
+                epoch, self.global_vars, new_vars, payload)
+            if rolled:
+                new_fg = self.fg_state
+        self.global_vars = new_vars
+        self.fg_state = new_fg
         return RoundInFlight(epoch=epoch, t0=t0, seg_epochs=seg_epochs,
                              agent_names=agent_names, adv_names=adv_names,
                              tasks_list=tasks_list, mask_list=mask_list,
-                             payload=payload, vars_after=self.global_vars,
+                             payload=payload, forced_degraded=rolled,
+                             vars_after=self.global_vars,
                              fg_after=self.fg_state,
                              rng_after=self._snapshot_rng())
 
@@ -870,6 +897,26 @@ class Experiment:
         nm = self.engine.base_norm_mult if norm_mult is None else norm_mult
         return (rng_f, prev, jnp.float32(nm))
 
+    def _health_gate(self, epoch, vars_before, new_vars, payload):
+        """Post-merge sentinel for the non-retrying dispatch paths: check
+        the committed model, and on an unhealthy merge roll back to the
+        last-good ring (falling back to the pre-round model), re-run the
+        global battery on the restored model, and splice it into the
+        payload so the recorded round stays finite. Returns
+        (vars, payload, rolled_back)."""
+        healthy, unorm = self._sentinel.check(vars_before, new_vars)
+        if healthy:
+            self._sentinel.commit(epoch, new_vars, unorm)
+            return new_vars, payload, False
+        self.telemetry.counter("health_rollbacks").inc()
+        target = self._sentinel.rollback_target(vars_before)
+        logger.warning(
+            "epoch %d: unhealthy aggregate (update norm %.3g vs EMA %.3g, "
+            "band %.1fx); rolled back to last-good model", epoch, unorm,
+            self._sentinel.ema, self._sentinel.band)
+        globals_dev = self.engine.global_evals_fn(target)
+        return target, payload[:1] + (globals_dev,) + payload[2:], True
+
     @staticmethod
     def _escalate_norm_mult(cur: float) -> float:
         """Retry-k screening escalation: switch the norm screen on if it was
@@ -893,6 +940,7 @@ class Experiment:
         C = int(idx_seq.shape[1])
         norm_mult: Optional[float] = None
         retries = 0
+        healthy, unorm = True, 0.0
         while True:
             extra = self._robust_round_args(epoch, C, norm_mult=norm_mult,
                                             use_carry=True)
@@ -905,11 +953,19 @@ class Experiment:
                     lane, ns_dev, rng_train, rng_agg, *extra)
             if not self.engine.screening:
                 finite = True  # unscreened injection: faults flow through
+                if self._sentinel is not None:
+                    # no norm screen to escalate — unhealthy goes straight
+                    # to the rollback path below
+                    healthy, unorm = self._sentinel.check(vars_before,
+                                                          new_vars)
                 break
             with self.guard.watch("round/screen_sync"), \
                     self.telemetry.span("round/screen_sync"):
                 finite = bool(payload[9].global_finite)  # the one host sync
-            if finite or retries >= self.max_round_retries:
+            healthy, unorm = True, 0.0
+            if finite and self._sentinel is not None:
+                healthy, unorm = self._sentinel.check(vars_before, new_vars)
+            if (finite and healthy) or retries >= self.max_round_retries:
                 break
             retries += 1
             cur = (self.engine.base_norm_mult if norm_mult is None
@@ -919,21 +975,30 @@ class Experiment:
                 time.sleep(min(self.retry_backoff_s * 2 ** (retries - 1),
                                30.0))
             logger.warning(
-                "epoch %d: aggregated model non-finite; retry %d/%d with "
-                "norm screen at %.2f× median", epoch, retries,
-                self.max_round_retries, norm_mult)
-        forced = self.engine.screening and not finite
+                "epoch %d: aggregated model %s; retry %d/%d with "
+                "norm screen at %.2f× median", epoch,
+                "non-finite" if not finite else "outside the health band",
+                retries, self.max_round_retries, norm_mult)
+        forced = (self.engine.screening and not finite) or not healthy
         if forced:
-            # retries exhausted and the aggregate is still non-finite:
-            # degrade — carry the pre-round model/defense state forward and
-            # re-run the global battery on it so the record stays finite
+            # retries exhausted and the aggregate is still non-finite (or
+            # outside the health band): degrade — restore the last-good
+            # model (the pre-round state when no ring is armed) and re-run
+            # the global battery on it so the record stays finite
             logger.warning(
-                "epoch %d: aggregated model non-finite after %d retries; "
-                "degraded round (global model carried forward)", epoch,
+                "epoch %d: aggregated model %s after %d retries; degraded "
+                "round (last-good model carried forward)", epoch,
+                "non-finite" if not finite else "outside the health band",
                 retries)
-            new_vars, new_fg = vars_before, fg_before
+            new_vars = (self._sentinel.rollback_target(vars_before)
+                        if self._sentinel is not None else vars_before)
+            new_fg = fg_before
+            if self._sentinel is not None and not healthy:
+                self.telemetry.counter("health_rollbacks").inc()
             globals_dev = self.engine.global_evals_fn(new_vars)
             payload = payload[:1] + (globals_dev,) + payload[2:]
+        elif self._sentinel is not None:
+            self._sentinel.commit(epoch, new_vars, unorm)
         self.global_vars = new_vars
         self.fg_state = new_fg
         stale_on = self.engine.fault_cfg.stale_enabled

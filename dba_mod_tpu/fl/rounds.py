@@ -21,7 +21,7 @@ helper.py:223-227).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -186,6 +186,79 @@ def screen_client_updates(deltas: ModelVars, reported: jax.Array,
     med = jnp.nanmedian(jnp.where(valid, norms, jnp.nan))
     thresh = jnp.where(norm_mult > 0, norm_mult * med, jnp.inf)
     return reported & finite & (norms <= thresh), norms
+
+
+def model_health_stats(old_vars: Any, new_vars: Any):
+    """The jitted half of the post-merge model-health sentinel: (all leaves
+    of the committed model finite, global L2 norm of the applied update).
+    One reduction pass over the tree — cheap relative to a round; callers
+    jit it once and pay one scalar host sync per checked merge."""
+    new_leaves = jax.tree_util.tree_leaves(new_vars)
+    finite = jnp.asarray(True)
+    sq = jnp.float32(0.0)
+    for o, n in zip(jax.tree_util.tree_leaves(old_vars), new_leaves):
+        if not jnp.issubdtype(n.dtype, jnp.floating):
+            continue
+        finite = finite & jnp.all(jnp.isfinite(n))
+        d = (n - o).astype(jnp.float32)
+        sq = sq + jnp.sum(d * d)
+    return finite, jnp.sqrt(sq)
+
+
+class HealthSentinel:
+    """Post-merge model-health gate shared by both engines
+    (``model_health_check``): an unhealthy merge is one whose committed
+    model has a non-finite leaf, or — once ``warmup`` healthy merges have
+    seeded the trailing EMA — whose update norm exceeds ``band`` × that
+    EMA (``health_norm_band``; 0 keeps only the finite check). Healthy
+    commits feed the EMA and a last-good ring of up to ``ring_size``
+    in-memory model versions; ``rollback_target`` hands back the newest
+    ring entry (or the caller's pre-merge fallback when the ring is off or
+    still empty). The ring is in-memory only — a resumed run restarts it
+    from its first healthy merge, while (ema, merges) ride the async aux
+    sidecar via state()/load_state() so the band re-arms deterministically."""
+
+    def __init__(self, band: float, ema_alpha: float, warmup: int,
+                 ring_size: int):
+        self.band = float(band)
+        self.alpha = float(ema_alpha)
+        self.warmup = int(warmup)
+        self.ring_size = int(ring_size)
+        self.ema = 0.0
+        self.merges = 0
+        self.ring: List[Tuple[int, Any]] = []  # (version, model vars)
+        self._fn = jax.jit(model_health_stats)
+
+    def check(self, old_vars: Any, new_vars: Any) -> Tuple[bool, float]:
+        """(healthy, update_norm) for one candidate merge — one host sync."""
+        finite, norm = jax.device_get(self._fn(old_vars, new_vars))
+        healthy = bool(finite)
+        if (healthy and self.band > 0 and self.merges >= max(1, self.warmup)
+                and self.ema > 0):
+            healthy = float(norm) <= self.band * self.ema
+        return healthy, float(norm)
+
+    def commit(self, version: int, new_vars: Any, norm: float) -> None:
+        """Record one healthy committed merge: advance the EMA and push the
+        model onto the last-good ring."""
+        self.merges += 1
+        self.ema = (norm if self.merges == 1
+                    else self.alpha * norm + (1.0 - self.alpha) * self.ema)
+        if self.ring_size > 0:
+            self.ring.append((int(version), new_vars))
+            if len(self.ring) > self.ring_size:
+                self.ring.pop(0)
+
+    def rollback_target(self, fallback: Any) -> Any:
+        return self.ring[-1][1] if self.ring else fallback
+
+    def state(self) -> Dict[str, Any]:
+        return {"ema": float(self.ema), "merges": int(self.merges)}
+
+    def load_state(self, st: Optional[Dict[str, Any]]) -> None:
+        if st:
+            self.ema = float(st.get("ema", 0.0))
+            self.merges = int(st.get("merges", 0))
 
 
 class AggregateResult(NamedTuple):

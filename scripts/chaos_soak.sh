@@ -1,0 +1,166 @@
+#!/usr/bin/env bash
+# make chaos-soak / make chaos-smoke: the self-healing soak harness.
+# Drives the sync and async engines (one lane each, derived from
+# configs/chaos_soak_params.yaml) under a seeded compound schedule —
+# every client fault lane at once plus the host-loss lane in the config,
+# while this script SIGTERMs or SIGKILLs the process at seeded instants
+# and flips a byte in a committed checkpoint between resumes. After the
+# final `--resume auto` leg completes, the lane must satisfy the
+# self-healing invariants: ONE run folder, aggregation steps 1..N exactly
+# once across every resume (monotonic versions, no duplicate recorder
+# steps), finite global metrics on every row, and a verified final
+# checkpoint. Any exit not caused by our own signal must be one of
+# {0, 75, 76, 77} (run_guard.py's exit contract).
+#
+# Env knobs: CHAOS_SEED (schedule seed, default 0), CHAOS_KILLS
+# (kill/resume cycles per lane, default 3), CHAOS_LANES (default
+# "async sync"). `make chaos-smoke` runs the single-kill async lane.
+# See README "Self-healing federation".
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED=${CHAOS_SEED:-0}
+KILLS=${CHAOS_KILLS:-3}
+LANES=${CHAOS_LANES:-"async sync"}
+CFG=configs/chaos_soak_params.yaml
+BASE_DIR=$(python -c "import yaml; print(yaml.safe_load(open('$CFG'))['run_dir'])")
+rm -rf "$BASE_DIR"; mkdir -p "$BASE_DIR"
+
+# seeded compound schedule: per cycle "rows_to_wait:signal:flip" — let the
+# run commit 1-3 more merges, hit it with SIGTERM or SIGKILL, and maybe
+# corrupt a checkpoint before the resume leg
+SCHEDULE=$(python - "$SEED" "$KILLS" <<'EOF'
+import random, sys
+r = random.Random(int(sys.argv[1]))
+print(" ".join(
+    f"{r.randint(1, 3)}:{r.choice(['TERM', 'KILL'])}:{int(r.random() < 0.5)}"
+    for _ in range(int(sys.argv[2]))))
+EOF
+)
+echo "chaos-soak: seed=$SEED kills=$KILLS lanes=[$LANES] schedule: $SCHEDULE"
+
+PID=""
+trap '[ -n "$PID" ] && kill -9 "$PID" 2>/dev/null || true' EXIT
+
+for LANE in $LANES; do
+  LANE_CFG="$BASE_DIR/${LANE}_params.yaml"
+  RUN_DIR="$BASE_DIR/$LANE"
+  python - "$CFG" "$LANE" "$RUN_DIR" "$LANE_CFG" <<'EOF'
+import sys, yaml
+cfg = yaml.safe_load(open(sys.argv[1]))
+cfg["mode"] = sys.argv[2]
+cfg["run_dir"] = sys.argv[3]
+yaml.safe_dump(cfg, open(sys.argv[4], "w"))
+EOF
+
+  rc=1
+  RESUME=""
+  for CYCLE in $SCHEDULE; do
+    WAIT_ROWS=${CYCLE%%:*}; REST=${CYCLE#*:}
+    SIG=${REST%%:*}; FLIP=${REST##*:}
+    BASE_ROWS=$({ cat "$RUN_DIR"/mnist_*/metrics.jsonl 2>/dev/null || true; } | wc -l)
+    TARGET=$((BASE_ROWS + WAIT_ROWS))
+
+    # shellcheck disable=SC2086
+    env JAX_PLATFORMS=cpu python -m dba_mod_tpu.main train \
+      --params "$LANE_CFG" $RESUME &
+    PID=$!
+    for _ in $(seq 1 600); do
+      n=$({ cat "$RUN_DIR"/mnist_*/metrics.jsonl 2>/dev/null || true; } | wc -l)
+      [ "${n:-0}" -ge "$TARGET" ] && break
+      kill -0 "$PID" 2>/dev/null || break   # finished before the signal
+      sleep 0.5
+    done
+    SIGNALLED=0
+    if kill -0 "$PID" 2>/dev/null; then
+      SIGNALLED=1
+      echo "chaos-soak[$LANE]: rows=$n -> SIG$SIG"
+      kill "-$SIG" "$PID" 2>/dev/null || true
+    fi
+    set +e; wait "$PID"; rc=$?; set -e
+    PID=""
+    echo "chaos-soak[$LANE]: run exited rc=$rc (signalled=$SIGNALLED sig=$SIG)"
+    if [ "$SIGNALLED" -eq 1 ] && [ "$SIG" = "KILL" ]; then
+      # 137 = killed by our own SIGKILL; anything else means the run beat
+      # the signal to a contract exit
+      case "$rc" in 137|0|75|76|77) ;; *)
+        echo "chaos-soak[$LANE]: unexpected exit code $rc after SIGKILL" >&2
+        exit 1 ;;
+      esac
+    else
+      case "$rc" in 0|75|76|77) ;; *)
+        echo "chaos-soak[$LANE]: exit code $rc outside the {0,75,76,77} contract" >&2
+        exit 1 ;;
+      esac
+    fi
+    [ "$rc" -eq 0 ] && break   # lane outran the schedule — soak done early
+
+    if [ "$FLIP" -eq 1 ]; then
+      # flip one byte in the newest verified snapshot — but only when an
+      # older verified snapshot exists for resume to fall back to
+      python - "$RUN_DIR" "$SEED" <<'EOF'
+import glob, random, sys
+from pathlib import Path
+from dba_mod_tpu import checkpoint as ckpt
+folders = sorted(glob.glob(sys.argv[1] + "/mnist_*"))
+if folders:
+    cands = [p for *_, p in ckpt._discovery_candidates(Path(folders[0]))]
+    verified = [p for p in cands if ckpt.verify_checkpoint(p)[0]]
+    print(f"chaos-soak: verified snapshots: {[p.name for p in verified]}")
+    if len(verified) >= 2:
+        r = random.Random(int(sys.argv[2]))
+        files = sorted(p for p in verified[0].rglob("*") if p.is_file())
+        f = files[r.randrange(len(files))]
+        data = bytearray(f.read_bytes())
+        if data:
+            i = r.randrange(len(data))
+            data[i] ^= 0xFF
+            # replace through a fresh inode: .prev clones hardlink their
+            # source (checkpoint.py::_clone_file), and an in-place write
+            # would corrupt BOTH snapshots through the shared data blocks
+            tmp = f.with_name(f.name + ".flip")
+            tmp.write_bytes(bytes(data))
+            tmp.replace(f)
+            print(f"chaos-soak: flipped byte {i} of {f}")
+    else:
+        print("chaos-soak: skipped byte-flip (needs 2 verified snapshots)")
+EOF
+    fi
+    RESUME="--resume auto"
+  done
+
+  if [ "$rc" -ne 0 ]; then
+    # final leg: resume to completion, no chaos
+    env JAX_PLATFORMS=cpu python -m dba_mod_tpu.main train \
+      --params "$LANE_CFG" --resume auto
+  fi
+
+  python - "$LANE_CFG" "$LANE" <<'EOF'
+import glob, json, math, sys, yaml
+cfg = yaml.safe_load(open(sys.argv[1]))
+lane = sys.argv[2]
+folders = sorted(glob.glob(cfg["run_dir"] + "/mnist_*"))
+assert len(folders) == 1, \
+    f"[{lane}] auto-resume must reuse the run folder, found {folders}"
+rows = [json.loads(l) for l in open(folders[0] + "/metrics.jsonl")]
+total = cfg["async_steps"] if lane == "async" else cfg["epochs"]
+eps = [r["epoch"] for r in rows]
+assert eps == list(range(1, total + 1)), \
+    f"[{lane}] expected steps 1..{total} exactly once across resumes, got {eps}"
+for r in rows:
+    assert math.isfinite(r["global_acc"]) and math.isfinite(r["global_loss"]), \
+        f"[{lane}] non-finite global metrics: {r}"
+    if lane == "async":
+        assert r["mode"] == "async", r
+from dba_mod_tpu import checkpoint as ckpt
+ok, reason = ckpt.verify_checkpoint(folders[0] + "/model_last.pt.tar")
+assert ok, f"[{lane}] final checkpoint failed verification: {reason}"
+degraded = sum(bool(r.get("degraded")) for r in rows)
+retried = sum(int(r.get("n_retries", 0)) for r in rows)
+quar = sum(int(r.get("n_quarantined", 0)) for r in rows)
+print(f"chaos-soak {lane} OK: {total} steps in {folders[0]} "
+      f"({degraded} degraded, {retried} retries, {quar} quarantined), "
+      "final checkpoint verified")
+EOF
+done
+echo "chaos-soak OK: lanes [$LANES] survived the schedule"

@@ -358,7 +358,7 @@ def _corrupting_round_fn(real_fn, fail_times):
             new_vars = jax.tree_util.tree_map(
                 lambda l: l * jnp.nan, new_vars)
             stats = payload[9]._replace(global_finite=jnp.asarray(False))
-            payload = payload[:9] + (stats,)
+            payload = payload[:9] + (stats,) + payload[10:]
         return new_vars, new_fg, payload, deltas_out
 
     return wrapped, calls

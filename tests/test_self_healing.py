@@ -1,0 +1,281 @@
+"""Self-healing server loop (fl/async_rounds.py, fl/experiment.py):
+merge deadlines + graceful starvation, wave backpressure + arrival TTL,
+the model-health sentinel with last-good-ring rollback in both engines,
+and the strict all-knobs-off bitwise no-op contract."""
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from dba_mod_tpu.config import Params
+from dba_mod_tpu.fl.async_rounds import AsyncDriver
+from dba_mod_tpu.fl.experiment import Experiment
+
+BASE = dict(
+    type="mnist", lr=0.1, batch_size=16, epochs=3, no_models=4,
+    number_of_total_participants=10, eta=0.8, aggregation_methods="mean",
+    internal_epochs=1, is_poison=False, synthetic_data=True,
+    synthetic_train_size=600, synthetic_test_size=256, momentum=0.9,
+    decay=0.0005, sampling_dirichlet=False, local_eval=False, random_seed=1)
+
+VOLATILE = {"time", "round_time", "dispatch_time", "finalize_time"}
+
+
+def _rows(exp, drop=()):
+    return [{k: v for k, v in r.items() if k not in VOLATILE | set(drop)}
+            for r in exp.recorder._jsonl_rows]
+
+
+def _bitwise_equal(a, b):
+    la, lb = (jax.tree_util.tree_leaves(t) for t in (a, b))
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+# ------------------------------------------------- strict no-op contract
+def test_inert_knob_values_are_bitwise_noop():
+    """Every self-healing knob set to a value that cannot fire (huge
+    deadline/TTL, generous watermark, health check with no band, a
+    non-default starvation policy on a stream that never starves) must
+    leave the async run bit-identical to the all-defaults run."""
+    cfg = dict(BASE, mode="async", buffer_k=2, async_steps=4,
+               arrival_rate=2.0, arrival_jitter=0.5, straggler_tail=0.2,
+               straggler_factor=5.0)
+    ref = Experiment(Params.from_dict(cfg), save_results=False)
+    ref.run()
+    loud = Experiment(Params.from_dict(dict(
+        cfg, merge_timeout_v=1e9, merge_min_k=2, starvation_policy="wait",
+        max_outstanding_waves=1000, arrival_ttl_v=1e9,
+        model_health_check=True, health_norm_band=0.0, rollback_ring=3)),
+        save_results=False)
+    loud.run()
+    assert _rows(ref) == _rows(loud)
+    assert _bitwise_equal(ref.global_vars, loud.global_vars)
+
+
+def test_sync_mode_ignores_self_healing_knobs():
+    """mode: sync with the async-side knobs set stays bit-identical —
+    the lockstep engine never reads them."""
+    ref = Experiment(Params.from_dict(dict(BASE, epochs=2)),
+                     save_results=False)
+    ref.run()
+    loud = Experiment(Params.from_dict(dict(
+        BASE, epochs=2, merge_timeout_v=3.0, merge_min_k=2,
+        starvation_policy="carry", max_outstanding_waves=2,
+        arrival_ttl_v=5.0)), save_results=False)
+    loud.run()
+    assert _rows(ref) == _rows(loud)
+    assert _bitwise_equal(ref.global_vars, loud.global_vars)
+
+
+# ------------------------------------------------------- merge deadlines
+def test_deadline_partial_merge_fires_and_is_deterministic():
+    """With a tight merge_timeout_v the merge fires before K arrivals —
+    partial occupancy rows — and two identical runs stay bit-identical."""
+
+    def run():
+        e = Experiment(Params.from_dict(dict(
+            BASE, mode="async", buffer_k=4, async_steps=6,
+            arrival_rate=0.5, arrival_jitter=0.8, straggler_tail=0.3,
+            straggler_factor=20.0, merge_timeout_v=0.05, merge_min_k=1)),
+            save_results=False)
+        d = AsyncDriver(e)
+        d.run_steps(6)
+        return e, d
+
+    ea, da = run()
+    eb, db = run()
+    occ = [r["buffer_occupancy"] for r in ea.recorder._jsonl_rows]
+    assert da.stats()["deadline_merges"] > 0
+    assert any(o < 4 for o in occ)          # partial merges actually fired
+    assert all(r["epoch"] == i + 1
+               for i, r in enumerate(ea.recorder._jsonl_rows))
+    assert _rows(ea) == _rows(eb)
+    assert da.stats() == db.stats()
+    assert _bitwise_equal(ea.global_vars, eb.global_vars)
+
+
+def test_deadline_merge_resume_bit_identical(tmp_path):
+    """Deadline-triggered partial merges survive a kill + --resume auto
+    bit-exactly: the buffered arrival times ride the async sidecar, so a
+    pending deadline re-arms with the same credit."""
+    cfg = dict(BASE, epochs=6, save_model=True, mode="async", buffer_k=4,
+               arrival_rate=0.5, arrival_jitter=0.8, straggler_tail=0.3,
+               straggler_factor=20.0, merge_timeout_v=0.05, merge_min_k=1,
+               staleness_weighting="polynomial", async_steps=8,
+               random_seed=3)
+
+    def rows(folder):
+        drop = VOLATILE | {"virtual_time"}
+        with open(Path(folder) / "metrics.jsonl") as f:
+            return [{k: v for k, v in json.loads(l).items()
+                     if k not in drop} for l in f if l.strip()]
+
+    ref = Experiment(Params.from_dict(dict(
+        cfg, run_dir=str(tmp_path / "ref"))), save_results=True)
+    ref.run()
+    ref_rows = rows(ref.folder)
+    assert any(r["buffer_occupancy"] < 4 for r in ref_rows)
+    a = Experiment(Params.from_dict(dict(
+        cfg, run_dir=str(tmp_path / "ab"), async_steps=4)),
+        save_results=True)
+    a.run()
+    folder = a.folder
+    del a
+    b = Experiment(Params.from_dict(dict(
+        cfg, run_dir=str(tmp_path / "ab"), resumed_model="auto")),
+        save_results=True)
+    assert (b._resume_aux or {}).get("async_state") is not None
+    b.run()
+    got = rows(folder)
+    assert [r["epoch"] for r in got] == list(range(1, 9))
+    assert got == ref_rows
+
+
+# ------------------------------------------------------------ backpressure
+def test_backpressure_caps_outstanding_waves():
+    """K larger than the per-cohort yield (heavy dropout) piles up
+    resident waves; max_outstanding_waves flushes partial merges at the
+    watermark instead."""
+    cfg = dict(BASE, mode="async", buffer_k=8, async_steps=4,
+               fault_injection=True, fault_dropout_prob=0.7, fault_seed=5)
+    e0 = Experiment(Params.from_dict(cfg), save_results=False)
+    d0 = AsyncDriver(e0)
+    d0.run_steps(4)
+    hw0 = d0.stats()["outstanding_waves_highwater"]
+    assert hw0 > 3                          # the pathological pile-up
+
+    e1 = Experiment(Params.from_dict(dict(cfg, max_outstanding_waves=3)),
+                    save_results=False)
+    d1 = AsyncDriver(e1)
+    d1.run_steps(4)
+    s1 = d1.stats()
+    assert s1["outstanding_waves_highwater"] <= 3
+    assert s1["backpressure_hits"] > 0
+    rows = e1.recorder._jsonl_rows
+    assert np.isfinite([r["global_acc"] for r in rows]).all()
+
+
+def test_arrival_ttl_expires_stragglers():
+    """arrival_ttl_v drops updates whose service delay exceeded the TTL —
+    they never reach the buffer, and the run still completes finite."""
+    cfg = dict(BASE, mode="async", buffer_k=2, async_steps=4,
+               straggler_tail=0.5, straggler_factor=1000.0,
+               arrival_ttl_v=20.0)
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    d = AsyncDriver(e)
+    d.run_steps(4)
+    assert d.stats()["expired_arrivals"] > 0
+    rows = e.recorder._jsonl_rows
+    assert [r["epoch"] for r in rows] == [1, 2, 3, 4]
+    assert np.isfinite([r["global_acc"] for r in rows]).all()
+
+
+# ------------------------------------------------------- graceful starvation
+def test_starvation_carry_records_degraded_noop_steps(monkeypatch):
+    """fault_dropout_prob=1.0 starves the arrival queue completely:
+    policy "carry" consumes the budget as recorded degraded no-op steps
+    (model untouched) instead of the pre-existing hard RuntimeError."""
+    from dba_mod_tpu.fl import async_rounds
+    monkeypatch.setattr(async_rounds, "STARVATION_LIMIT", 5)
+    cfg = dict(BASE, mode="async", buffer_k=2, async_steps=1,
+               fault_injection=True, fault_dropout_prob=1.0, fault_seed=7)
+    with pytest.raises(RuntimeError, match="starved"):
+        Experiment(Params.from_dict(cfg), save_results=False).run()
+
+    e = Experiment(Params.from_dict(dict(cfg, starvation_policy="carry")),
+                   save_results=False)
+    before = jax.device_get(e.global_vars)
+    e.run()
+    rows = e.recorder._jsonl_rows
+    assert [r["epoch"] for r in rows] == [1]
+    assert rows[0]["degraded"] and rows[0]["buffer_occupancy"] == 0
+    assert np.isfinite(rows[0]["global_acc"])
+    assert _bitwise_equal(before, jax.device_get(e.global_vars))
+
+
+# ------------------------------------------------- health sentinel + rollback
+def test_async_rollback_restores_premerge_model_bit_exactly():
+    """A merge outside the health band rolls back to the last-good ring:
+    the committed model after the unhealthy merge is bit-identical to the
+    pre-merge model, the step is recorded degraded, and the stream keeps
+    going."""
+    cfg = dict(BASE, mode="async", buffer_k=4, async_steps=3,
+               model_health_check=True, health_norm_band=1e-9,
+               health_warmup_merges=1, rollback_ring=2)
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    d = AsyncDriver(e)
+    d.run_steps(1)                          # merge 1 seeds the EMA
+    good = jax.device_get(e.global_vars)
+    d.run_steps(2)                          # merges 2..3: outside the band
+    assert d.stats()["health_rollbacks"] == 2
+    assert _bitwise_equal(good, jax.device_get(e.global_vars))
+    rows = e.recorder._jsonl_rows
+    assert [r["degraded"] for r in rows] == [False, True, True]
+    assert np.isfinite([r["global_acc"] for r in rows]).all()
+
+
+def test_async_min_surviving_clients_skips_and_carries():
+    """The sync min_surviving_clients degradation, ported to the buffered
+    merge: a screen that leaves too few survivors skips aggregation and
+    carries the model."""
+    cfg = dict(BASE, mode="async", buffer_k=4, async_steps=2,
+               fault_injection=True, fault_corrupt_prob=1.0, fault_seed=3,
+               min_surviving_clients=1)
+    e = Experiment(Params.from_dict(cfg), save_results=False)
+    before = jax.device_get(e.global_vars)
+    e.run()
+    rows = e.recorder._jsonl_rows
+    # every payload NaN-corrupted → screened out → zero survivors → carry
+    assert all(r["degraded"] for r in rows)
+    assert all(r["n_quarantined"] == 4 for r in rows)
+    assert _bitwise_equal(before, jax.device_get(e.global_vars))
+    assert np.isfinite([r["global_acc"] for r in rows]).all()
+
+
+def test_sync_health_rollback_degrades_round():
+    """The sentinel in the lockstep engine: after the EMA seeds, a normal
+    round's update norm sits far outside a microscopic band — every later
+    round degrades and the model stays pinned at the last-good version."""
+    e = Experiment(Params.from_dict(dict(
+        BASE, epochs=3, model_health_check=True, health_norm_band=1e-9,
+        health_warmup_merges=1, rollback_ring=2)), save_results=False)
+    e.run()
+    rows = e.recorder._jsonl_rows
+    assert [r["degraded"] for r in rows] == [False, True, True]
+    assert np.isfinite([r["global_acc"] for r in rows]).all()
+    assert _bitwise_equal(e._sentinel.ring[-1][1], e.global_vars)
+
+
+def test_sync_health_check_with_no_band_is_value_identical():
+    """model_health_check with band 0 (finite-only) must not change any
+    recorded value of a healthy sync run."""
+    ref = Experiment(Params.from_dict(dict(BASE, epochs=2)),
+                     save_results=False)
+    ref.run()
+    chk = Experiment(Params.from_dict(dict(
+        BASE, epochs=2, model_health_check=True)), save_results=False)
+    chk.run()
+    assert _rows(ref) == _rows(chk)
+    assert _bitwise_equal(ref.global_vars, chk.global_vars)
+
+
+# ------------------------------------------------------------ config guards
+def test_self_healing_config_rejections():
+    with pytest.raises(ValueError, match="starvation_policy"):
+        Params.from_dict(dict(BASE, starvation_policy="panic"))
+    with pytest.raises(ValueError, match="merge_timeout_v"):
+        Params.from_dict(dict(BASE, merge_timeout_v=-1.0))
+    with pytest.raises(ValueError, match="merge_min_k"):
+        Params.from_dict(dict(BASE, merge_min_k=0))
+    with pytest.raises(ValueError, match="max_outstanding_waves"):
+        Params.from_dict(dict(BASE, max_outstanding_waves=-1))
+    with pytest.raises(ValueError, match="arrival_ttl_v"):
+        Params.from_dict(dict(BASE, arrival_ttl_v=-0.5))
+    with pytest.raises(ValueError, match="health_ema_alpha"):
+        Params.from_dict(dict(BASE, health_ema_alpha=0.0))
+    with pytest.raises(ValueError, match="rollback_ring"):
+        Params.from_dict(dict(BASE, rollback_ring=-1))
