@@ -3,7 +3,7 @@
 # 8-virtual-device platform tests/conftest.py sets up.
 SHELL := /bin/bash
 .PHONY: tier1 test-slow trace crash-smoke elastic-smoke forensics-smoke \
-  async-smoke chaos-soak chaos-smoke
+  async-smoke chaos-soak chaos-smoke overlap-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -65,6 +65,13 @@ chaos-soak:
 # CI-sized slice of the soak: the async lane only, one seeded kill cycle.
 chaos-smoke:
 	CHAOS_KILLS=1 CHAOS_LANES=async bash scripts/chaos_soak.sh
+
+# Round-pipelining drill (README "Round pipelining"): four tiny CLI runs —
+# {sync, async} x {overlap_eval off, on} — then assert the canonical run
+# outputs (metrics.jsonl + every recorder CSV, wall-clock columns
+# stripped) are byte-identical off vs on for both engines.
+overlap-smoke:
+	bash scripts/overlap_smoke.sh
 
 # Defense-forensics drill (README "Defense forensics"): tiny FoolsGold
 # sybil run with `forensics: true`, assert forensics.jsonl +

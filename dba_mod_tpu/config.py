@@ -141,6 +141,15 @@ _DEFAULTS: Dict[str, Any] = {
                                    # fully-masked no-ops)
     "pipeline_rounds": False,      # overlap round N's host fetch with round
                                    # N+1's device compute in Experiment.run
+    "overlap_eval": False,         # split the fused round program and overlap
+                                   # round N's eval batteries + host
+                                   # record/checkpoint with round N+1's
+                                   # train/aggregate dispatch (async engine:
+                                   # pipeline host bookkeeping with the next
+                                   # merge). Eval inputs are snapshots of the
+                                   # superseded model, so recorded metrics are
+                                   # bit-identical to the serial path; off
+                                   # (default) is a strict bit-identical no-op
     "fused_updates": "auto",       # fused pallas per-step state update;
                                    # auto = on for unsharded TPU runs
     "fused_interpret": False,      # run the fused kernels in pallas

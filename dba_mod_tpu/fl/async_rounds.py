@@ -196,6 +196,42 @@ class _Wave:
     t_dispatch: float = 0.0      # virtual clock at dispatch (arrival_ttl_v)
 
 
+@dataclasses.dataclass
+class _MergeInFlight:
+    """One dispatched-but-unfinalized merge (overlap_eval's async analog of
+    experiment.RoundInFlight): device handles of the merge outputs + every
+    host value finalize needs, captured at dispatch time — by finalize time
+    the live driver state (version, clock, heap, RNG streams, global model)
+    already belongs to the NEXT step's fill."""
+    step: int
+    t0: float                    # perf_counter at dispatch start
+    globals_dev: Any
+    wv: Any
+    alpha: Any
+    is_updated: Any
+    n_quar: Any
+    degr: Any
+    names: List[Any]
+    adversaries: List[Any]
+    staleness: np.ndarray
+    occupancy: int
+    retries: int
+    rolled_back: bool
+    n_dropped: int
+    dispatch_wall: float
+    extras: Dict[str, Any]
+    entries: List[Tuple[int, int]]
+    rows: List[_Wave]            # cohorts resolved since the previous merge,
+    # in resolution order — finalize replays them before the merge rows
+    t_dispatch_end: float = 0.0
+    # checkpoint capture (run() only): the streaming sidecar + model/RNG
+    # state at dispatch — what save_model must persist for THIS step
+    snapshot: Optional[Dict[str, Any]] = None
+    vars_after: Any = None
+    fg_after: Any = None
+    rng_after: Optional[Dict[str, Any]] = None
+
+
 class AsyncDriver:
     """The persistent buffered-async server loop over one Experiment."""
 
@@ -255,6 +291,26 @@ class AsyncDriver:
         self._rollbacks = 0
         self._waves_highwater = 0
         self._merge_latencies: List[float] = []
+        # cohorts fully resolved (merged/dropped/expired) whose per-client
+        # rows have not been written yet — drained into the next merge's
+        # handle and replayed, in resolution order, at its finalize
+        self._pending_rows: List[_Wave] = []
+        # overlap_eval: pipeline each merge's host finalize (device fetch +
+        # row recording + checkpoint) behind the NEXT step's fill/merge
+        # compute. Gated off under telemetry (per-step span/epoch
+        # attribution stays honest) and for poisoned LOAN runs (the
+        # adaptive-LR probe reads last_backdoor_acc at wave dispatch, which
+        # pipelining would make one more merge stale than the documented
+        # deviation). Off ⇒ this module is a strict bit-identical no-op of
+        # the serial driver; on, the recorded stream is byte-identical by
+        # construction — finalize replays the deferred rows in resolution
+        # order before anything later records.
+        self._pipeline = (bool(p.get("overlap_eval", False))
+                          and not exp.telemetry.enabled
+                          and not (p.type == cfg.TYPE_LOAN
+                                   and exp.is_poison_run))
+        self._overlap_merges = 0
+        self._overlap_hidden_s = 0.0
         self._merge_fn = self._build_merge_fn()
         fcfg = exp.engine.fault_cfg
         self._perturb_fn = (jax.jit(
@@ -366,8 +422,28 @@ class AsyncDriver:
             # K == C this is exactly `epochs` merges
             total = max(1, eps * self.C // self.K)
         last: Dict[str, Any] = {}
+        # overlap_eval: hold ONE dispatched-but-unfinalized merge, so step
+        # S's device fetch + row recording + checkpoint drain behind step
+        # S+1's fill (wave training) and merge compute — the async analog
+        # of the sync engine's depth-1 pipelined loop
+        pending: Optional[_MergeInFlight] = None
+
+        def _drain(p: Optional[_MergeInFlight]) -> Optional[Dict[str, Any]]:
+            if p is None:
+                return None
+            r = self._finalize_merge(p)
+            self._save_pending(p)
+            exp.telemetry.mark_warm()
+            logger.info(
+                "merge %d/%d done acc=%.2f staleness_mean=%.2f "
+                "occupancy=%d/%d", p.step, total, r["global_acc"],
+                r["staleness_mean"], r["buffer_occupancy"], self.K)
+            return r
+
         while self.version < total:
             if exp.guard.stop_requested:
+                last = _drain(pending) or last
+                pending = None
                 if self._buffer:
                     # graceful stop: flush the partial buffer as one final
                     # padded merge (occupancy < K — same compiled shape)
@@ -379,8 +455,15 @@ class AsyncDriver:
                     "step %d (resume with --resume auto)", self.version)
                 break
             if self._fill_buffer():
+                if self._pipeline:
+                    nxt = self._dispatch_merge(capture_save=True)
+                    last = _drain(pending) or last
+                    pending = nxt
+                    continue
                 last = self._merge_and_record()
             else:
+                last = _drain(pending) or last
+                pending = None
                 last = self._carry_starved_step()
             self._save()
             exp.telemetry.mark_warm()
@@ -388,6 +471,7 @@ class AsyncDriver:
                 "merge %d/%d done acc=%.2f staleness_mean=%.2f "
                 "occupancy=%d/%d", self.version, total, last["global_acc"],
                 last["staleness_mean"], last["buffer_occupancy"], self.K)
+        last = _drain(pending) or last
         leftovers = len(self._buffer) + len(self._heap)
         if leftovers and not exp.interrupted:
             exp.telemetry.counter("async/unmerged_leftovers").inc(leftovers)
@@ -396,13 +480,28 @@ class AsyncDriver:
         return last
 
     def run_steps(self, n: int) -> Dict[str, Any]:
-        """Run exactly n merges (bench.py's --async lane), no checkpoints."""
+        """Run exactly n merges (bench.py's --async lane), no checkpoints.
+        Under overlap_eval the merges are pipelined depth-1 exactly like
+        run(); the trailing merge is drained before returning, so n calls
+        leave no in-flight state behind."""
         last: Dict[str, Any] = {}
+        pending: Optional[_MergeInFlight] = None
         for _ in range(n):
             if self._fill_buffer():
+                if self._pipeline:
+                    nxt = self._dispatch_merge()
+                    if pending is not None:
+                        last = self._finalize_merge(pending)
+                    pending = nxt
+                    continue
                 last = self._merge_and_record()
             else:
+                if pending is not None:
+                    last = self._finalize_merge(pending)
+                    pending = None
                 last = self._carry_starved_step()
+        if pending is not None:
+            last = self._finalize_merge(pending)
         return last
 
     def stats(self) -> Dict[str, Any]:
@@ -418,7 +517,11 @@ class AsyncDriver:
                 "expired_arrivals": self._expired_arrivals,
                 "deadline_merges": self._deadline_merges,
                 "backpressure_hits": self._backpressure_hits,
-                "health_rollbacks": self._rollbacks}
+                "health_rollbacks": self._rollbacks,
+                # overlap_eval: merges finalized one step late + host
+                # seconds that ran behind the next step's compute
+                "pipelined_merges": self._overlap_merges,
+                "hidden_finalize_s": round(self._overlap_hidden_s, 6)}
 
     def _save(self):
         self.exp.save_model(self.version,
@@ -450,7 +553,7 @@ class AsyncDriver:
         self.exp.telemetry.counter("async/expired_arrivals").inc()
         w.outstanding -= 1
         if w.outstanding == 0 and not w.recorded:
-            self._record_wave_rows(w)
+            self._resolve_wave(w)
             del self._waves[wid]
         return True
 
@@ -604,8 +707,8 @@ class AsyncDriver:
                 outstanding=int(len(agent_names) - dropped.sum()),
                 t_dispatch=self.clock)
             if self._waves[wid].outstanding == 0:
-                # fully dropped cohort: record its train rows now and free it
-                self._record_wave_rows(self._waves[wid])
+                # fully dropped cohort: resolve its train rows and free it
+                self._resolve_wave(self._waves[wid])
                 del self._waves[wid]
         if len(self._waves) > self._waves_highwater:
             self._waves_highwater = len(self._waves)
@@ -618,7 +721,21 @@ class AsyncDriver:
     def _merge_and_record(self) -> Dict[str, Any]:
         """Merge the buffer (padded to K), advance the version, run the
         global battery, and record one metrics.jsonl row keyed by the
-        aggregation step."""
+        aggregation step. Serial composition of the two merge phases; the
+        pipelined run() loop holds the dispatched handle across one fill
+        instead."""
+        return self._finalize_merge(self._dispatch_merge())
+
+    def _dispatch_merge(self, capture_save: bool = False) -> _MergeInFlight:
+        """Phase 1 of a merge: consume the buffer, run the jitted merge
+        (with the sentinel retry loop), dispatch the global battery, and
+        COMMIT the new model/version — returning without blocking on the
+        eval transfer. Every host value the deferred finalize needs is
+        captured in the handle, because by finalize time the live driver
+        state may already belong to the next step's fill. With
+        ``capture_save`` the checkpoint payload (streaming snapshot +
+        model/RNG state) is captured too, at exactly the state a serial
+        post-merge save would see."""
         exp = self.exp
         t0 = time.perf_counter()
         step = self.version + 1
@@ -626,13 +743,16 @@ class AsyncDriver:
         entries = sorted(self._buffer)     # (wave, lane) — deterministic
         self._buffer = []
         B = len(entries)
-        # per-client rows for cohorts that fully resolved with this batch
+        # per-client rows for cohorts that fully resolved with this batch:
+        # resolution is deferred into the handle and replayed at finalize —
+        # the serial path finalizes immediately, so the recorded stream is
+        # order-identical in both modes
         for wid, _lane in entries:
             self._waves[wid].outstanding -= 1
         for wid in sorted({w for w, _ in entries}):
             w = self._waves[wid]
             if w.outstanding == 0 and not w.recorded:
-                self._record_wave_rows(w)
+                self._resolve_wave(w)
         names = [self._waves[w].names[lane] for w, lane in entries]
         merged_by_wave: Dict[int, set] = {}
         for (wid, lane) in entries:
@@ -704,47 +824,100 @@ class AsyncDriver:
             globals_dev = exp.engine.global_evals_fn(new_vars)
         exp.global_vars = new_vars
         self.version = step
-        # free fully-consumed cohorts (their payloads are merged + recorded)
+        # free fully-consumed cohorts (their payloads are merged + resolved)
         for wid in [w for w, v in self._waves.items()
                     if v.outstanding == 0 and v.recorded]:
             del self._waves[wid]
-        with exp.telemetry.span("async/finalize"):
-            t_fin = time.perf_counter()
-            (globals_, wv_h, alpha_h, is_upd_h, n_quar_h,
-             degr_h) = jax.device_get(
-                (globals_dev, wv, alpha, is_updated, n_quar, degr))
-        finalize_time = time.perf_counter() - t_fin
-        degraded = bool(degr_h) or rolled_back
-        if self._sentinel is not None and not rolled_back and not degraded:
-            self._sentinel.commit(step, new_vars, unorm)
-        exp.last_is_updated = bool(is_upd_h)
-        exp.last_global_loss = float(globals_.clean.loss)
-        if exp.is_poison_run:
-            exp.last_backdoor_acc = float(globals_.poison.acc)
-        times = {"round_time": time.perf_counter() - t0,
-                 "dispatch_time": self._dispatch_wall,
-                 "finalize_time": finalize_time}
-        self._dispatch_wall = 0.0
-        robust = {"n_quarantined": int(n_quar_h),
-                  "n_dropped": self._pending_dropped,
-                  "n_retries": retries, "degraded": degraded}
-        self._pending_dropped = 0
+        if self._sentinel is not None and not rolled_back:
+            # commit the ring at DISPATCH so the sentinel observes merge S
+            # before merge S+1's candidate is checked against it — the same
+            # observation order as the serial path. The degradation scalar
+            # is already synced (sentinel.check device_gets the norms), so
+            # this fetch does not stall the pipeline.
+            degr_host = bool(jax.device_get(degr))
+            if not degr_host:
+                self._sentinel.commit(step, new_vars, unorm)
         extras = {"mode": "async", "buffer_occupancy": B,
                   "staleness_mean": float(staleness.mean()) if B else 0.0,
                   "staleness_max": float(staleness.max()) if B else 0.0,
                   "waves_dispatched": self.wave,
                   "arrivals_total": self._total_arrivals,
                   "virtual_time": self.clock}
-        self._record_merge(step, entries, names, adversaries, globals_,
-                           wv_h, alpha_h, times, robust, extras)
+        h = _MergeInFlight(
+            step=step, t0=t0, globals_dev=globals_dev, wv=wv, alpha=alpha,
+            is_updated=is_updated, n_quar=n_quar, degr=degr, names=names,
+            adversaries=adversaries, staleness=staleness, occupancy=B,
+            retries=retries, rolled_back=rolled_back,
+            n_dropped=self._pending_dropped,
+            dispatch_wall=self._dispatch_wall, extras=extras,
+            entries=entries, rows=self._pending_rows)
+        self._pending_rows = []
+        self._pending_dropped = 0
+        self._dispatch_wall = 0.0
+        if capture_save:
+            h.snapshot = self._snapshot()
+            h.vars_after = new_vars
+            h.fg_after = exp.fg_state
+            h.rng_after = exp._snapshot_rng()
+        h.t_dispatch_end = time.perf_counter()
+        return h
+
+    def _finalize_merge(self, h: _MergeInFlight) -> Dict[str, Any]:
+        """Phase 2 of a merge: block on the eval transfer, replay the
+        deferred per-client rows (in resolution order), and record the
+        merge. Under overlap_eval this runs one step late — everything it
+        touches rides the handle, so the recorded stream is byte-identical
+        to the serial composition."""
+        exp = self.exp
+        with exp.telemetry.span("async/finalize"):
+            t_fin = time.perf_counter()
+            (globals_, wv_h, alpha_h, is_upd_h, n_quar_h,
+             degr_h) = jax.device_get(
+                (h.globals_dev, h.wv, h.alpha, h.is_updated, h.n_quar,
+                 h.degr))
+        finalize_time = time.perf_counter() - t_fin
+        if self._pipeline:
+            self._overlap_merges += 1
+            self._overlap_hidden_s += max(0.0, t_fin - h.t_dispatch_end)
+        for w in h.rows:
+            self._record_wave_rows(w)
+        degraded = bool(degr_h) or h.rolled_back
+        exp.last_is_updated = bool(is_upd_h)
+        exp.last_global_loss = float(globals_.clean.loss)
+        if exp.is_poison_run:
+            exp.last_backdoor_acc = float(globals_.poison.acc)
+        times = {"round_time": time.perf_counter() - h.t0,
+                 "dispatch_time": h.dispatch_wall,
+                 "finalize_time": finalize_time}
+        robust = {"n_quarantined": int(n_quar_h), "n_dropped": h.n_dropped,
+                  "n_retries": h.retries, "degraded": degraded}
+        self._record_merge(h.step, h.entries, h.names, h.adversaries,
+                           globals_, wv_h, alpha_h, times, robust, h.extras)
         exp.telemetry.counter("async/merges").inc()
-        exp.telemetry.counter("async/updates_merged").inc(B)
-        self._flush_merge_telemetry(step, robust, times)
-        return {"epoch": step, "agents": names,
+        exp.telemetry.counter("async/updates_merged").inc(h.occupancy)
+        self._flush_merge_telemetry(h.step, robust, times)
+        return {"epoch": h.step, "agents": h.names,
                 "global_acc": float(globals_.clean.acc),
                 "backdoor_acc": (float(globals_.poison.acc)
                                  if exp.is_poison_run else None),
-                **times, **robust, **extras}
+                **times, **robust, **h.extras}
+
+    def _save_pending(self, h: _MergeInFlight):
+        """Checkpoint a finalized pipelined merge from its dispatch-time
+        capture. Runs AFTER _finalize_merge(h): save_model reads
+        last_global_loss (best-val) and last_backdoor_acc, which finalize
+        just set from this merge's battery — the same values a serial save
+        would see."""
+        if h.snapshot is None:
+            return
+        from dba_mod_tpu.fl.experiment import RoundInFlight
+        fl = RoundInFlight(
+            epoch=h.step, t0=h.t0, seg_epochs=[], agent_names=[],
+            adv_names=[], tasks_list=[], mask_list=[], payload=None,
+            vars_after=h.vars_after, fg_after=h.fg_after,
+            rng_after=h.rng_after)
+        self.exp.save_model(h.step, fl=fl,
+                            extra_aux={"async_state": h.snapshot})
 
     def _carry_starved_step(self) -> Dict[str, Any]:
         """starvation_policy "carry": the stream produced no arrivals for
@@ -755,6 +928,7 @@ class AsyncDriver:
         t0 = time.perf_counter()
         step = self.version + 1
         exp.telemetry.set_epoch(step)
+        self._flush_pending_rows()  # cohorts expired during the starved fill
         globals_dev = exp.engine.global_evals_fn(exp.global_vars)
         self.version = step
         globals_ = jax.device_get(globals_dev)
@@ -830,6 +1004,24 @@ class AsyncDriver:
                 np.concatenate(pid_parts).astype(np.int32))
 
     # ------------------------------------------------------------- recording
+    def _resolve_wave(self, w: _Wave):
+        """Mark a fully-consumed cohort resolved and queue its per-client
+        rows. Rows are ALWAYS deferred (both modes) and replayed in
+        resolution order by the next finalize — identical in-memory stream
+        to recording inline, but under overlap_eval the device_get of the
+        cohort's train metrics rides the hidden finalize instead of
+        stalling the dispatch path."""
+        w.recorded = True
+        self._pending_rows.append(w)
+
+    def _flush_pending_rows(self):
+        """Record any resolved-but-unrecorded cohorts now — the non-merge
+        recording paths (starved carry steps) must flush before they write
+        their own rows to keep the stream ordered."""
+        rows, self._pending_rows = self._pending_rows, []
+        for w in rows:
+            self._record_wave_rows(w)
+
     def _record_wave_rows(self, w: _Wave):
         """Per-client rows for one fully-resolved cohort: train metrics and
         (when local_eval) the local battery — the same row semantics as the

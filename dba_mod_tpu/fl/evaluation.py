@@ -53,6 +53,34 @@ def instrument_eval(fn, name: str, batches: int = 0):
     return telemetry.instrument(fn, name, batches=batches)
 
 
+def pick_eval_device(mesh, overlap: bool):
+    """The device the overlap_eval batteries should run on, or None to
+    share device 0. A SECOND local device (when present, and only without a
+    clients mesh — sharded batteries stay on the mesh) gives true compute
+    overlap: round N's eval executables compile against their own
+    placement-cached copy of the test-set constants (JAX places
+    closure-captured data per compiled executable), so they run while
+    device 0 executes round N+1's train/aggregate. With one device the
+    batteries still dispatch ahead but only the host-side fetch/record/
+    checkpoint path is hidden."""
+    if not overlap or mesh is not None:
+        return None
+    devs = jax.local_devices()
+    return devs[1] if len(devs) > 1 else None
+
+
+def place_eval_inputs(operands, device):
+    """One-hop ``jax.device_put`` of the overlap path's eval operands onto
+    the eval device (passthrough when placement is off). The operands are
+    the superseded round's SNAPSHOTS (model, pre-fault deltas, task row) —
+    transferring them here, at dispatch, is what lets the donated/overwritten
+    device-0 buffers belong to round N+1 while N's batteries still read
+    bit-identical inputs."""
+    if device is None:
+        return operands
+    return jax.device_put(operands, device)
+
+
 def make_eval_fn(model_def: ModelDef, data: DeviceData, poison: bool):
     """evaluate(model_vars, idx[S,B], slots[S,B], mask[S,B], adv_index)
     -> EvalResult. `poison` is static: True stamps every sample with trigger

@@ -28,6 +28,7 @@ from dba_mod_tpu.data import (build_batch_plan, build_eval_plan,
 from dba_mod_tpu.data.partition import (equal_split_indices,
                                         poison_test_indices,
                                         sample_dirichlet_indices)
+from dba_mod_tpu.fl import evaluation
 from dba_mod_tpu.fl.device_data import (make_image_device_data,
                                         make_loan_device_data)
 from dba_mod_tpu.fl.rounds import EvalPlans, RoundEngine
@@ -103,6 +104,12 @@ class RoundInFlight:
     # to round N+1 when round N checkpoints). None unless the stale lane
     # is on.
     deltas_after: Any = None
+    # overlap_eval bookkeeping: the round ran the split core + overlapped
+    # eval batteries, and eval_dispatch_t is the perf_counter when the last
+    # battery was enqueued — finalize_round turns (fetch wall time vs time
+    # since enqueue) into the hidden-eval clock
+    overlapped: bool = False
+    eval_dispatch_t: float = 0.0
 
 
 class Experiment:
@@ -352,6 +359,24 @@ class Experiment:
                 ema_alpha=float(params.get("health_ema_alpha", 0.1)),
                 warmup=int(params.get("health_warmup_merges", 3)),
                 ring_size=int(params.get("rollback_ring", 0)))
+        # overlap_eval (README "Round pipelining"): dispatch round N's eval
+        # batteries + host record/checkpoint concurrently with round N+1's
+        # train/aggregate. The scheduler lives in _dispatch_overlap; here we
+        # only pick the eval placement: with >1 local device and no clients
+        # mesh the batteries run on a SECOND device (true compute overlap —
+        # the eval executables get their own placement-cached data
+        # constants), otherwise they share device 0 and the overlap hides
+        # the host-side fetch/record/checkpoint path only. sequential_debug
+        # takes precedence (see _dispatch); with telemetry on the split
+        # program still runs but the loop stays SEQUENTIAL (_run_rounds) so
+        # span attribution is honest. Off is a strict no-op — no core
+        # program is ever compiled.
+        self._overlap = bool(params.get("overlap_eval", False))
+        self._eval_device = evaluation.pick_eval_device(self.mesh,
+                                                        self._overlap)
+        self._overlap_rounds = 0
+        self._overlap_hidden_s = 0.0  # cumulative eval+fetch seconds hidden
+        self._overlap_wait_s = 0.0    # cumulative finalize blocking seconds
         self._fault_key = jax.random.key(self.engine.fault_cfg.seed)
         # last round's submitted deltas (the stale lane's replay source).
         # Checkpointed in the aux sidecar when the lane is on (save_model
@@ -571,10 +596,25 @@ class Experiment:
                     # warm the program real rounds run: the fused round —
                     # or, under telemetry's split-phase dispatch, the train
                     # program (the only split program whose shape varies
-                    # with the step bucket; aggregate/eval are bucket-free)
-                    if self._telemetry_split and not self.sequential_debug:
+                    # with the step bucket; aggregate/eval are bucket-free),
+                    # or the overlap scheduler's round core. The donated
+                    # twin is warmed on COPIES: donation consumes the input
+                    # buffers, and these are the live model/defense state.
+                    if self._overlap and not self.sequential_debug:
+                        self.engine.core_fn(self.global_vars, self.fg_state,
+                                            tasks_seq, idx, mask, lane, ns,
+                                            rng_t, rng_a, *robust_args)
+                    elif self._telemetry_split and not self.sequential_debug:
                         self.engine.train_fn(self.global_vars, tasks_seq,
                                              idx, mask, lane, rng_t)
+                    elif self._use_donated_round:
+                        gv = jax.tree_util.tree_map(lambda x: x.copy(),
+                                                    self.global_vars)
+                        fg = jax.tree_util.tree_map(lambda x: x.copy(),
+                                                    self.fg_state)
+                        self.engine.round_fn_donated(
+                            gv, fg, tasks_seq, idx, mask, lane, ns,
+                            rng_t, rng_a)
                     else:
                         self.engine.round_fn(self.global_vars, self.fg_state,
                                              tasks_seq, idx, mask, lane, ns,
@@ -639,6 +679,16 @@ class Experiment:
         instance, stay honest: host planning + enqueue / blocking fetch)."""
         return (self.telemetry.enabled and not self.engine.robust
                 and telemetry.current() is self.telemetry)
+
+    @property
+    def _use_donated_round(self) -> bool:
+        """Route through the fused round's donated twin (non-CPU, non-robust
+        — see the gate in rounds.py) only when nothing re-reads the consumed
+        buffers after dispatch: the health sentinel's check/rollback path
+        does (it compares against the pre-round model), and the overlap
+        scheduler never runs the fused program at all."""
+        return (self.engine.round_fn_donated is not None
+                and self._sentinel is None and not self._overlap)
 
     def dispatch_round(self, epoch: int) -> RoundInFlight:
         """Telemetry/timing shell around :meth:`_dispatch`: the whole host
@@ -759,15 +809,30 @@ class Experiment:
         # require running train/aggregate/evals as separate programs with an
         # explicit sync each (the same programs sequential_debug and
         # bench.py's phase probe already exercise).
-        use_split = self.sequential_debug or self._telemetry_split
+        # overlap_eval outranks the telemetry split: its batteries are
+        # instrument_eval-wrapped (each call synced under telemetry) and the
+        # round loop is forced sequential (_run_rounds), so the split core +
+        # standalone batteries give the same honest per-phase attribution
+        # the telemetry split path exists for.
+        use_split = (self.sequential_debug
+                     or (self._telemetry_split and not self._overlap))
         if not use_split:
+            if self._overlap:
+                return self._dispatch_overlap(
+                    epoch, t0, seg_epochs, agent_names, adv_names,
+                    tasks_list, mask_list, tasks_seq, idx_seq, mask_seq,
+                    lane, ns_dev, rng_train, rng_agg)
             if self.engine.robust:
                 return self._dispatch_robust(
                     epoch, t0, seg_epochs, agent_names, adv_names,
                     tasks_list, mask_list, tasks_seq, idx_seq, mask_seq,
                     lane, ns_dev, rng_train, rng_agg)
-            # one program, one dispatch: train → aggregate → evals
-            new_vars, new_fg, payload = self.engine.round_fn(
+            # one program, one dispatch: train → aggregate → evals (the
+            # donated twin when the gate allows — same program, XLA may
+            # reuse the consumed state buffers in place)
+            rf = (self.engine.round_fn_donated if self._use_donated_round
+                  else self.engine.round_fn)
+            new_vars, new_fg, payload = rf(
                 self.global_vars, self.fg_state, tasks_seq, idx_seq,
                 mask_seq, lane, ns_dev, rng_train, rng_agg)
             rolled = False
@@ -897,23 +962,33 @@ class Experiment:
         nm = self.engine.base_norm_mult if norm_mult is None else norm_mult
         return (rng_f, prev, jnp.float32(nm))
 
-    def _health_gate(self, epoch, vars_before, new_vars, payload):
-        """Post-merge sentinel for the non-retrying dispatch paths: check
-        the committed model, and on an unhealthy merge roll back to the
-        last-good ring (falling back to the pre-round model), re-run the
-        global battery on the restored model, and splice it into the
-        payload so the recorded round stays finite. Returns
-        (vars, payload, rolled_back)."""
+    def _health_check(self, epoch, vars_before, new_vars):
+        """The sentinel decision alone — check the merged model BEFORE
+        anything of round N+1 commits (the overlap scheduler calls this
+        between the core program and the eval dispatch; the serial paths
+        via _health_gate below). Returns (vars_to_commit, rolled_back);
+        on a healthy merge the sentinel's EMA/ring commit happens here."""
         healthy, unorm = self._sentinel.check(vars_before, new_vars)
         if healthy:
             self._sentinel.commit(epoch, new_vars, unorm)
-            return new_vars, payload, False
+            return new_vars, False
         self.telemetry.counter("health_rollbacks").inc()
         target = self._sentinel.rollback_target(vars_before)
         logger.warning(
             "epoch %d: unhealthy aggregate (update norm %.3g vs EMA %.3g, "
             "band %.1fx); rolled back to last-good model", epoch, unorm,
             self._sentinel.ema, self._sentinel.band)
+        return target, True
+
+    def _health_gate(self, epoch, vars_before, new_vars, payload):
+        """Post-merge sentinel for the non-retrying SERIAL dispatch paths:
+        _health_check, plus — because those paths already ran the global
+        battery on the pre-rollback model — a re-run on the restored model
+        spliced into the payload so the recorded round stays finite.
+        Returns (vars, payload, rolled_back)."""
+        target, rolled = self._health_check(epoch, vars_before, new_vars)
+        if not rolled:
+            return target, payload, False
         globals_dev = self.engine.global_evals_fn(target)
         return target, payload[:1] + (globals_dev,) + payload[2:], True
 
@@ -1013,6 +1088,145 @@ class Experiment:
             rng_after=self._snapshot_rng(),
             deltas_after=deltas_out if stale_on else None)
 
+    def _dispatch_overlap(self, epoch, t0, seg_epochs, agent_names,
+                          adv_names, tasks_list, mask_list, tasks_seq,
+                          idx_seq, mask_seq, lane, ns_dev, rng_train,
+                          rng_agg) -> RoundInFlight:
+        """The overlap scheduler (overlap_eval): run the round CORE — the
+        fused program minus its eval tail (train → [faults → screen] →
+        aggregate) — commit the model update, THEN dispatch round N's eval
+        batteries as separate programs against the retained pre-round
+        buffers. The pipelined loop in _run_rounds dispatches round N+1's
+        core immediately after this returns, so the batteries (pure
+        functions of the superseded model) and the host fetch/record/
+        checkpoint path run concurrently with N+1's train. Contracts:
+
+        * bit-identity — the batteries are the same jitted programs the
+          fused round inlines, on the same inputs (pre-fault deltas,
+          pre-round globals, post-commit model); fused ≡ core+batteries is
+          A/B-verified by tests/test_overlap.py;
+        * sentinel-before-commit — _health_check gates the merged model
+          between the core and the eval dispatch, so the sentinel observes
+          round N before anything of N+1 is enqueued, exactly as on the
+          serial path;
+        * retry cancellation — a rejected robust attempt never had evals in
+          flight (the core returns only train/aggregate state); the
+          batteries dispatch once, for the accepted (or force-degraded)
+          attempt, whose train deltas are identical across attempts
+          (rng_train and the fault key are fixed per epoch)."""
+        engine = self.engine
+        vars_before, fg_before = self.global_vars, self.fg_state
+        retries = 0
+        forced = False
+        deltas_out = ()
+        if not engine.robust:
+            new_vars, new_fg, payload, eval_in = engine.core_fn(
+                vars_before, fg_before, tasks_seq, idx_seq, mask_seq, lane,
+                ns_dev, rng_train, rng_agg)
+            if self._sentinel is not None:
+                new_vars, forced = self._health_check(epoch, vars_before,
+                                                      new_vars)
+                if forced:
+                    new_fg = fg_before
+        else:
+            C = int(idx_seq.shape[1])
+            norm_mult: Optional[float] = None
+            healthy, unorm = True, 0.0
+            while True:
+                extra = self._robust_round_args(epoch, C,
+                                                norm_mult=norm_mult,
+                                                use_carry=True)
+                with self.telemetry.span("round/compute"):
+                    (new_vars, new_fg, payload, deltas_out,
+                     eval_in) = engine.core_fn(
+                        vars_before, fg_before, tasks_seq, idx_seq,
+                        mask_seq, lane, ns_dev, rng_train, rng_agg, *extra)
+                if not engine.screening:
+                    finite = True
+                    if self._sentinel is not None:
+                        healthy, unorm = self._sentinel.check(vars_before,
+                                                              new_vars)
+                    break
+                with self.guard.watch("round/screen_sync"), \
+                        self.telemetry.span("round/screen_sync"):
+                    finite = bool(payload[9].global_finite)
+                healthy, unorm = True, 0.0
+                if finite and self._sentinel is not None:
+                    healthy, unorm = self._sentinel.check(vars_before,
+                                                          new_vars)
+                if (finite and healthy) or retries >= self.max_round_retries:
+                    break
+                retries += 1
+                cur = (engine.base_norm_mult if norm_mult is None
+                       else norm_mult)
+                norm_mult = self._escalate_norm_mult(cur)
+                if self.retry_backoff_s > 0:
+                    time.sleep(min(
+                        self.retry_backoff_s * 2 ** (retries - 1), 30.0))
+                logger.warning(
+                    "epoch %d: aggregated model %s; retry %d/%d with "
+                    "norm screen at %.2f× median", epoch,
+                    "non-finite" if not finite
+                    else "outside the health band",
+                    retries, self.max_round_retries, norm_mult)
+            forced = (engine.screening and not finite) or not healthy
+            if forced:
+                logger.warning(
+                    "epoch %d: aggregated model %s after %d retries; "
+                    "degraded round (last-good model carried forward)",
+                    epoch, "non-finite" if not finite
+                    else "outside the health band", retries)
+                new_vars = (self._sentinel.rollback_target(vars_before)
+                            if self._sentinel is not None else vars_before)
+                new_fg = fg_before
+                if self._sentinel is not None and not healthy:
+                    self.telemetry.counter("health_rollbacks").inc()
+            elif self._sentinel is not None:
+                self._sentinel.commit(epoch, new_vars, unorm)
+        # the model update is decided — commit, so the caller can enqueue
+        # round N+1's core before the batteries below have drained
+        self.global_vars = new_vars
+        self.fg_state = new_fg
+        stale_on = engine.fault_cfg.stale_enabled
+        if stale_on:
+            self._prev_deltas = deltas_out
+        # eval dispatch against snapshots of the superseded buffers. With a
+        # second local device the inputs are copied there and the same
+        # jitted batteries compile a per-device executable (their
+        # closure-captured eval data is placed per executable and cached),
+        # so N's eval compute itself overlaps N+1's train — otherwise the
+        # batteries share device 0 behind N+1's enqueue and the overlap
+        # hides the host-side fetch/record/checkpoint path.
+        deltas_pre, prev_dev, seg_deltas = eval_in
+        tasks_last = jax.tree_util.tree_map(lambda l: l[-1], tasks_seq)
+        scales, adv_slots = tasks_seq.scale, tasks_seq.adv_slot
+        vars_old, vars_new = vars_before, new_vars
+        (vars_old, vars_new, deltas_pre, prev_dev, seg_deltas, tasks_last,
+         scales, adv_slots) = evaluation.place_eval_inputs(
+            (vars_old, vars_new, deltas_pre, prev_dev, seg_deltas,
+             tasks_last, scales, adv_slots), self._eval_device)
+        locals_dev = (engine.local_evals_fn(vars_old, deltas_pre,
+                                            tasks_last, prev_dev)
+                      if self.local_eval else None)
+        seg_locals_dev = None
+        if self.local_eval and engine.seg_local_evals_fn is not None:
+            seg_locals_dev = engine.seg_local_evals_fn(
+                vars_old, list(seg_deltas), scales, adv_slots)
+        globals_dev = engine.global_evals_fn(vars_new)
+        payload = ((locals_dev, globals_dev) + payload[2:8]
+                   + (seg_locals_dev,) + payload[9:])
+        fl = RoundInFlight(
+            epoch=epoch, t0=t0, seg_epochs=seg_epochs,
+            agent_names=agent_names, adv_names=adv_names,
+            tasks_list=tasks_list, mask_list=mask_list, payload=payload,
+            n_retries=retries, forced_degraded=forced,
+            vars_after=new_vars, fg_after=new_fg,
+            rng_after=self._snapshot_rng(),
+            deltas_after=deltas_out if stale_on else None,
+            overlapped=True)
+        fl.eval_dispatch_t = time.perf_counter()
+        return fl
+
     def _snapshot_rng(self) -> Dict[str, Any]:
         """Host snapshot of every RNG stream a round consumes, taken right
         after dispatch consumed them — the state a resumed run needs to
@@ -1039,6 +1253,25 @@ class Experiment:
         times = {"round_time": time.perf_counter() - fl.t0,
                  "dispatch_time": fl.dispatch_time,
                  "finalize_time": finalize_time}
+        if fl.overlapped:
+            # honest attribution of the overlapped eval+sync work: of the
+            # wall time since the batteries were enqueued, finalize only
+            # BLOCKED for finalize_time — the rest drained behind whatever
+            # the caller dispatched in between (round N+1's core under the
+            # pipelined loop). Mirrored to the overlap/ telemetry family
+            # when telemetry is wired (bench reads the experiment counters
+            # directly — the pipelined loop runs with telemetry off).
+            since_enqueue = time.perf_counter() - fl.eval_dispatch_t
+            hidden = max(0.0, since_enqueue - finalize_time)
+            self._overlap_rounds += 1
+            self._overlap_hidden_s += hidden
+            self._overlap_wait_s += finalize_time
+            t = self.telemetry
+            if t.enabled:
+                t.counter("overlap/rounds").inc()
+                t.gauge("overlap/hidden_eval_s").set(self._overlap_hidden_s)
+                t.gauge("overlap/dispatch_ahead_depth").set(1.0)
+                t.histogram("overlap/eval_wait_s").observe(finalize_time)
         self.last_is_updated = bool(is_updated)
         self.last_global_loss = float(globals_.clean.loss)
         if self.is_poison_run:
@@ -1528,7 +1761,11 @@ class Experiment:
         # alone on the timeline), and so does telemetry: finalize(N) flushes
         # round N's histogram window, which dispatch(N+1) — fully synced on
         # the split path — would otherwise pollute with round N+1's spans.
-        if (bool(self.params.get("pipeline_rounds", False))
+        # overlap_eval rides the same depth-1 loop: its dispatch returns
+        # with round N's eval batteries still in flight, so dispatching
+        # N+1's core before finalizing N is what actually hides them
+        if ((bool(self.params.get("pipeline_rounds", False))
+                or self._overlap)
                 and not profile_dir and not self.telemetry.enabled):
             def finalize_and_log(fl):
                 r = self.finalize_round(fl)

@@ -724,7 +724,8 @@ class RoundEngine:
 
         def _round(global_vars: ModelVars, fg_state, tasks_seq, idx_seq,
                    mask_seq, lane, num_samples, rng_t, rng_a,
-                   rng_f=None, prev_deltas=(), norm_mult=None):
+                   rng_f=None, prev_deltas=(), norm_mult=None,
+                   with_evals=True):
             robust = norm_mult is not None  # trace-time switch
             train = train_fn(global_vars, tasks_seq, idx_seq, mask_seq,
                              lane, rng_t)
@@ -820,20 +821,38 @@ class RoundEngine:
                         res.num_oracle_calls)
             prev = (train.seg_deltas[-1] if num_segments > 1 else
                     jax.tree_util.tree_map(jnp.zeros_like, train.deltas))
-            # the local battery evaluates what each client TRAINED (faults
-            # model the uplink, not local training) — pre-fault deltas
-            locals_ = (local_evals(global_vars, train.deltas, tasks_last,
-                                   prev)
-                       if do_local_eval else None)
-            seg_l = (seg_local_evals(global_vars, train.seg_deltas,
-                                     tasks_seq.scale, tasks_seq.adv_slot)
-                     if do_local_eval and num_segments > 1 else None)
-            globals_ = global_evals(res.new_vars)
+            if with_evals:
+                # the local battery evaluates what each client TRAINED
+                # (faults model the uplink, not local training) — pre-fault
+                # deltas
+                locals_ = (local_evals(global_vars, train.deltas, tasks_last,
+                                       prev)
+                           if do_local_eval else None)
+                seg_l = (seg_local_evals(global_vars, train.seg_deltas,
+                                         tasks_seq.scale, tasks_seq.adv_slot)
+                         if do_local_eval and num_segments > 1 else None)
+                globals_ = global_evals(res.new_vars)
+            else:
+                # overlap_eval's round CORE: the eval tail is stripped —
+                # the dispatcher runs the SAME jitted batteries as separate
+                # programs against the returned eval inputs, after the model
+                # commit, so they overlap the next round's train dispatch
+                locals_ = seg_l = globals_ = None
             track_pair = ((train.batch_loss, train.batch_dist)
                           if hyper.track_batches else None)
             payload = (locals_, globals_, train.metrics, train.delta_norms,
                        res.wv, res.alpha, track_pair, res.is_updated, seg_l,
                        stats, fstats)
+            if not with_evals:
+                # everything the stripped batteries need that only exists
+                # inside the program: the PRE-fault deltas (the local
+                # battery's input even on the robust path), the final
+                # segment's anchor, and the per-segment deltas
+                eval_in = (train.deltas, prev, tuple(train.seg_deltas))
+                if robust:
+                    return (res.new_vars, res.new_fg_state, payload,
+                            deltas_out, eval_in)
+                return res.new_vars, res.new_fg_state, payload, eval_in
             if robust:
                 return res.new_vars, res.new_fg_state, payload, deltas_out
             return res.new_vars, res.new_fg_state, payload
@@ -850,6 +869,26 @@ class RoundEngine:
                           mask_seq, lane, num_samples, rng_t, rng_a,
                           rng_f, prev_deltas, norm_mult)
 
+        # The round CORE for the overlap_eval scheduler: train → [faults →
+        # screen] → aggregate, with the eval tail stripped and the eval
+        # inputs returned instead. Snapshot contract: the core must NOT
+        # donate (or otherwise alias) its input buffers — the overlapped
+        # eval batteries read the RETAINED pre-round global_vars and the
+        # returned delta snapshots after round N+1's core has already been
+        # enqueued against the new model.
+        def core_fn(global_vars: ModelVars, fg_state, tasks_seq, idx_seq,
+                    mask_seq, lane, num_samples, rng_t, rng_a):
+            return _round(global_vars, fg_state, tasks_seq, idx_seq,
+                          mask_seq, lane, num_samples, rng_t, rng_a,
+                          with_evals=False)
+
+        def core_fn_robust(global_vars: ModelVars, fg_state, tasks_seq,
+                           idx_seq, mask_seq, lane, num_samples, rng_t,
+                           rng_a, rng_f, prev_deltas, norm_mult):
+            return _round(global_vars, fg_state, tasks_seq, idx_seq,
+                          mask_seq, lane, num_samples, rng_t, rng_a,
+                          rng_f, prev_deltas, norm_mult, with_evals=False)
+
         if mesh is not None:
             from dba_mod_tpu.parallel.mesh import (client_sharding,
                                                    replicated_sharding,
@@ -863,18 +902,57 @@ class RoundEngine:
             # is host-local on EVERY process of a multi-host run
             base_in = (rep2, rep2, seg_cs2, seg_cs2, seg_cs2, cs2, cs2,
                        rep2, rep2)
+            # the eval-input snapshot trio (deltas, prev anchor, seg deltas)
+            # keeps the client sharding the eval batteries expect
+            eval_out = (cs2, cs2, cs2)
             if self.robust:
                 self.round_fn = jax.jit(
                     round_fn_robust,
                     in_shardings=base_in + (rep2, cs2, rep2),
                     out_shardings=(rep2, rep2, rep2, cs2))
+                self.core_fn = jax.jit(
+                    core_fn_robust,
+                    in_shardings=base_in + (rep2, cs2, rep2),
+                    out_shardings=(rep2, rep2, rep2, cs2, eval_out))
             else:
                 self.round_fn = jax.jit(
                     round_fn, in_shardings=base_in,
                     out_shardings=(rep2, rep2, rep2))
+                self.core_fn = jax.jit(
+                    core_fn, in_shardings=base_in,
+                    out_shardings=(rep2, rep2, rep2, eval_out))
         else:
             self.round_fn = jax.jit(round_fn_robust if self.robust
                                     else round_fn)
+            self.core_fn = jax.jit(core_fn_robust if self.robust
+                                   else core_fn)
+
+        # Donation gate (snapshot/donation contract): the fused round is the
+        # LAST reader of its (global_vars, fg_state) buffers on the
+        # steady-state non-robust path, so on non-CPU backends a donated
+        # twin lets XLA reuse those buffers in place — model-sized headroom
+        # per round. Three exclusions, each load-bearing:
+        #   * CPU: buffers are host RAM — aliasing saves nothing and XLA:CPU
+        #     donation is the one backend where it has historically been
+        #     fragile, so the gate stays off (tier-1 runs are CPU);
+        #   * robust: the retry loop re-runs the program with the SAME
+        #     captured inputs, which donation would have invalidated;
+        #   * core_fn/overlap: the overlapped eval batteries read the
+        #     retained pre-round buffers AFTER the next core is enqueued —
+        #     the core never donates (see core_fn above).
+        # Experiment-side contract: route through round_fn_donated only when
+        # no health sentinel is armed (its check/rollback re-reads the
+        # pre-round model), and warm calls must pass copies.
+        self.round_fn_donated = None
+        if not self.robust and jax.default_backend() != "cpu":
+            if mesh is not None:
+                self.round_fn_donated = jax.jit(
+                    round_fn, in_shardings=base_in,
+                    out_shardings=(rep2, rep2, rep2),
+                    donate_argnums=(0, 1))
+            else:
+                self.round_fn_donated = jax.jit(round_fn,
+                                                donate_argnums=(0, 1))
 
         # Split-path forensics (sequential_debug / telemetry's per-phase
         # dispatch — the robust path is never split): the same ForensicStats

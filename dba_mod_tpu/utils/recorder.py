@@ -52,6 +52,43 @@ ROUND_HEADER = ["epoch", "global_acc", "global_loss", "backdoor_acc",
                 "n_quarantined", "n_dropped", "n_retries", "degraded",
                 "round_time", "dispatch_time", "finalize_time"]
 
+# wall-clock columns/keys: the ONLY recorded values allowed to differ
+# between a serial run and the same run under overlap_eval /
+# pipeline_rounds. Everything else is covered by the bit-identity
+# contract (README "Round pipelining"; tests/test_overlap.py)
+VOLATILE_KEYS = frozenset(
+    {"time", "round_time", "dispatch_time", "finalize_time"})
+
+
+def canonical_run_outputs(folder) -> dict:
+    """Wall-clock-free view of a run folder's recorded outputs, for
+    byte-level A/B comparison of two runs (the overlap_eval bit-identity
+    contract). metrics.jsonl rows and round_result.csv drop the
+    VOLATILE_KEYS columns; every other CSV is compared as raw bytes."""
+    folder = Path(folder)
+    out: dict = {}
+    mj = folder / "metrics.jsonl"
+    if mj.exists():
+        out["metrics.jsonl"] = [
+            {k: v for k, v in json.loads(line).items()
+             if k not in VOLATILE_KEYS}
+            for line in mj.read_text().splitlines() if line.strip()]
+    rr = folder / "round_result.csv"
+    if rr.exists():
+        with open(rr, newline="") as f:
+            rows = list(csv.reader(f))
+        keep = [i for i, c in enumerate(rows[0])
+                if c not in VOLATILE_KEYS] if rows else []
+        out["round_result.csv"] = [[r[i] for i in keep] for r in rows]
+    for name in ("train_result.csv", "test_result.csv",
+                 "posiontest_result.csv", "poisontriggertest_result.csv",
+                 "weight_result.csv", "scale_result.csv",
+                 "train_batch_result.csv", "distance_result.csv"):
+        p = folder / name
+        if p.exists():
+            out[name] = p.read_bytes()
+    return out
+
 
 def _tag(name: Any) -> str:
     return str(name).replace("/", "_")

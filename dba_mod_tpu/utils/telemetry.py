@@ -47,6 +47,21 @@ from typing import Any, Callable, Dict, List, Optional
 
 logger = logging.getLogger("dba_mod_tpu")
 
+# Round-pipelining metric family (fl/experiment.py, fl/async_rounds.py —
+# README "Round pipelining"). Emitted only when overlap_eval is ON and
+# telemetry is ON, which forces the round loop SEQUENTIAL: per-phase span
+# attribution (dispatch vs eval vs finalize) is only honest when phases do
+# not overlap, so the engines trade the pipelining away rather than record
+# misattributed spans. The counters below therefore measure the split
+# program running serially — the hidden-time clocks come from the
+# experiment's host-side accumulators (bench.py reports them per lane).
+#   overlap/rounds              counter — rounds run through the split path
+#   overlap/hidden_eval_s       gauge   — cumulative eval+sync seconds that
+#                                         ran behind the next dispatch
+#   overlap/dispatch_ahead_depth gauge  — in-flight rounds ahead (depth 1)
+#   overlap/eval_wait_s         histogram — per-round blocking fetch tail
+OVERLAP_METRIC_PREFIX = "overlap/"
+
 # jax.monitoring event fired on every backend compile — i.e. every jit cache
 # miss that actually reaches XLA (tracing-only cache hits don't fire it).
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"

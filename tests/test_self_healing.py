@@ -1,7 +1,12 @@
 """Self-healing server loop (fl/async_rounds.py, fl/experiment.py):
 merge deadlines + graceful starvation, wave backpressure + arrival TTL,
 the model-health sentinel with last-good-ring rollback in both engines,
-and the strict all-knobs-off bitwise no-op contract."""
+and the strict all-knobs-off bitwise no-op contract.
+
+Every e2e rehearsal here runs multi-round Experiment pairs (the expensive
+XLA-compile + A/B-run pattern); they are slow-marked so tier 1 keeps only
+the config-guard test, and the full battery rides tier 2 / the nightly
+lane."""
 import json
 from pathlib import Path
 
@@ -36,6 +41,7 @@ def _bitwise_equal(a, b):
 
 
 # ------------------------------------------------- strict no-op contract
+@pytest.mark.slow
 def test_inert_knob_values_are_bitwise_noop():
     """Every self-healing knob set to a value that cannot fire (huge
     deadline/TTL, generous watermark, health check with no band, a
@@ -56,6 +62,7 @@ def test_inert_knob_values_are_bitwise_noop():
     assert _bitwise_equal(ref.global_vars, loud.global_vars)
 
 
+@pytest.mark.slow
 def test_sync_mode_ignores_self_healing_knobs():
     """mode: sync with the async-side knobs set stays bit-identical —
     the lockstep engine never reads them."""
@@ -72,6 +79,7 @@ def test_sync_mode_ignores_self_healing_knobs():
 
 
 # ------------------------------------------------------- merge deadlines
+@pytest.mark.slow
 def test_deadline_partial_merge_fires_and_is_deterministic():
     """With a tight merge_timeout_v the merge fires before K arrivals —
     partial occupancy rows — and two identical runs stay bit-identical."""
@@ -98,6 +106,7 @@ def test_deadline_partial_merge_fires_and_is_deterministic():
     assert _bitwise_equal(ea.global_vars, eb.global_vars)
 
 
+@pytest.mark.slow
 def test_deadline_merge_resume_bit_identical(tmp_path):
     """Deadline-triggered partial merges survive a kill + --resume auto
     bit-exactly: the buffered arrival times ride the async sidecar, so a
@@ -136,6 +145,7 @@ def test_deadline_merge_resume_bit_identical(tmp_path):
 
 
 # ------------------------------------------------------------ backpressure
+@pytest.mark.slow
 def test_backpressure_caps_outstanding_waves():
     """K larger than the per-cohort yield (heavy dropout) piles up
     resident waves; max_outstanding_waves flushes partial merges at the
@@ -159,6 +169,7 @@ def test_backpressure_caps_outstanding_waves():
     assert np.isfinite([r["global_acc"] for r in rows]).all()
 
 
+@pytest.mark.slow
 def test_arrival_ttl_expires_stragglers():
     """arrival_ttl_v drops updates whose service delay exceeded the TTL —
     they never reach the buffer, and the run still completes finite."""
@@ -175,6 +186,7 @@ def test_arrival_ttl_expires_stragglers():
 
 
 # ------------------------------------------------------- graceful starvation
+@pytest.mark.slow
 def test_starvation_carry_records_degraded_noop_steps(monkeypatch):
     """fault_dropout_prob=1.0 starves the arrival queue completely:
     policy "carry" consumes the budget as recorded degraded no-op steps
@@ -198,6 +210,7 @@ def test_starvation_carry_records_degraded_noop_steps(monkeypatch):
 
 
 # ------------------------------------------------- health sentinel + rollback
+@pytest.mark.slow
 def test_async_rollback_restores_premerge_model_bit_exactly():
     """A merge outside the health band rolls back to the last-good ring:
     the committed model after the unhealthy merge is bit-identical to the
@@ -218,6 +231,7 @@ def test_async_rollback_restores_premerge_model_bit_exactly():
     assert np.isfinite([r["global_acc"] for r in rows]).all()
 
 
+@pytest.mark.slow
 def test_async_min_surviving_clients_skips_and_carries():
     """The sync min_surviving_clients degradation, ported to the buffered
     merge: a screen that leaves too few survivors skips aggregation and
@@ -236,6 +250,7 @@ def test_async_min_surviving_clients_skips_and_carries():
     assert np.isfinite([r["global_acc"] for r in rows]).all()
 
 
+@pytest.mark.slow
 def test_sync_health_rollback_degrades_round():
     """The sentinel in the lockstep engine: after the EMA seeds, a normal
     round's update norm sits far outside a microscopic band — every later
@@ -250,6 +265,7 @@ def test_sync_health_rollback_degrades_round():
     assert _bitwise_equal(e._sentinel.ring[-1][1], e.global_vars)
 
 
+@pytest.mark.slow
 def test_sync_health_check_with_no_band_is_value_identical():
     """model_health_check with band 0 (finite-only) must not change any
     recorded value of a healthy sync run."""
